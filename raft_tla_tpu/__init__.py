@@ -1,0 +1,27 @@
+"""raft_tla_tpu — a TPU-native exhaustive model checker for the Raft TLA+ spec.
+
+Re-architects TLC's explicit-state BFS of ``Spec == Init /\\ [][Next]_vars``
+(reference ``raft.tla:469``) as massively data-parallel tensor computation:
+
+- the spec's ``Next`` relation (``raft.tla:454-465``) compiles to a batched,
+  jittable successor function over a fixed-width int32 tensor state encoding
+  (``ops/state.py``);
+- the BFS frontier is vmapped across HBM (``engine.py``);
+- 64-bit state fingerprints deduplicate through a two-lane multilinear hash
+  (``ops/fingerprint.py``; Pallas kernel in ``ops/pallas_fp.py``);
+- the frontier shards over a ``jax.sharding.Mesh`` with ``all_to_all``
+  fingerprint routing and ``psum`` termination detection (``parallel/``);
+- the checker is driven through the stock ``raft.cfg``
+  SPECIFICATION/INVARIANT/CONSTANTS interface (``utils/cfgparse.py``) so stock
+  TLC remains the CPU reference oracle (``models/tla_export.py`` emits the
+  patched module TLC needs).
+
+The semantic ground truth is the reference spec at ``/root/reference/raft.tla``
+(Ongaro's dissertation spec); every kernel cites the lines it implements.
+"""
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+
+__version__ = "0.1.0"
+
+__all__ = ["Bounds", "CheckConfig", "__version__"]

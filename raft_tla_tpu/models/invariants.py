@@ -1,0 +1,126 @@
+"""Invariant registry — the checker's L5 ``INVARIANT`` stanza target.
+
+The reference cfg declares ``INVARIANT NoTwoLeaders`` (``raft.cfg:3``) but no
+such operator exists in ``raft.tla`` (SURVEY §0 defect 1); ``README.md:5``
+defers to an external PR.  The registry therefore *defines* it, as Raft's
+**Election Safety** — at most one leader per term:
+
+    \\A i, j \\in Server :
+        (state[i] = Leader /\\ state[j] = Leader
+         /\\ currentTerm[i] = currentTerm[j]) => i = j
+
+A naive "never two simultaneous leaders in any terms" reading is NOT an
+invariant of Raft — a deposed leader keeps ``state = Leader`` until it
+observes a higher term via ``UpdateTerm`` (``raft.tla:406-412``) — so it is
+kept in the registry as ``NaiveNoTwoLeaders``, the canonical smoke test that
+the checker finds real violations and reconstructs traces.
+
+Every invariant has two faces sharing one definition site: a Python predicate
+over :class:`~raft_tla_tpu.models.interp.PyState` (oracle side) and a jnp
+predicate over the tensor struct (vmapped over the frontier, device side).
+"""
+
+from __future__ import annotations
+
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.models import spec as S
+
+
+# -- Python (oracle) predicates: state -> bool (True = invariant holds) ------
+
+def _py_election_safety(s, bounds: Bounds) -> bool:
+    n = bounds.n_servers
+    return not any(
+        s.role[i] == S.LEADER and s.role[j] == S.LEADER
+        and s.term[i] == s.term[j]
+        for i in range(n) for j in range(i + 1, n))
+
+
+def _py_naive_no_two_leaders(s, bounds: Bounds) -> bool:
+    return sum(1 for r in s.role if r == S.LEADER) <= 1
+
+
+def _py_log_matching(s, bounds: Bounds) -> bool:
+    """If two logs share (index, term), they agree on the whole prefix."""
+    n = bounds.n_servers
+    for i in range(n):
+        for j in range(i + 1, n):
+            li, lj = s.log[i], s.log[j]
+            for k in range(min(len(li), len(lj))):
+                if li[k][0] == lj[k][0] and li[:k + 1] != lj[:k + 1]:
+                    return False
+    return True
+
+
+def _py_committed_within_log(s, bounds: Bounds) -> bool:
+    """commitIndex never points past the log (sanity, provable from the spec)."""
+    return all(s.commitIndex[i] <= len(s.log[i])
+               for i in range(bounds.n_servers))
+
+
+# -- jnp (device) predicates: struct -> scalar bool --------------------------
+
+def _jnp_election_safety(bounds: Bounds):
+    import jax.numpy as jnp
+
+    def inv(st):
+        is_l = st["role"] == S.LEADER
+        same_term = st["term"][:, None] == st["term"][None, :]
+        both = is_l[:, None] & is_l[None, :] & same_term
+        off_diag = ~jnp.eye(bounds.n_servers, dtype=bool)
+        return ~jnp.any(both & off_diag)
+    return inv
+
+
+def _jnp_naive_no_two_leaders(bounds: Bounds):
+    import jax.numpy as jnp
+
+    def inv(st):
+        return jnp.sum((st["role"] == S.LEADER).astype(jnp.int32)) <= 1
+    return inv
+
+
+def _jnp_log_matching(bounds: Bounds):
+    import jax.numpy as jnp
+
+    def inv(st):
+        lt, lv, ln = st["logTerm"], st["logVal"], st["logLen"]
+        L = lt.shape[1]
+        ks = jnp.arange(L)
+        # [i, j, k] masks
+        valid = (ks[None, None, :]
+                 < jnp.minimum(ln[:, None], ln[None, :])[:, :, None])
+        term_eq = lt[:, None, :] == lt[None, :, :]
+        ent_eq = term_eq & (lv[:, None, :] == lv[None, :, :])
+        prefix_eq = jnp.cumprod(ent_eq.astype(jnp.int32), axis=-1) > 0
+        bad = valid & term_eq & ~prefix_eq
+        return ~jnp.any(bad)
+    return inv
+
+
+def _jnp_committed_within_log(bounds: Bounds):
+    import jax.numpy as jnp
+
+    def inv(st):
+        return jnp.all(st["commitIndex"] <= st["logLen"])
+    return inv
+
+
+# name -> (python predicate, jnp predicate builder)
+REGISTRY = {
+    # The reference cfg's undefined operator, defined (see module docstring).
+    "NoTwoLeaders": (_py_election_safety, _jnp_election_safety),
+    "ElectionSafety": (_py_election_safety, _jnp_election_safety),
+    # Deliberately falsifiable — exercises violation reporting + traces.
+    "NaiveNoTwoLeaders": (_py_naive_no_two_leaders, _jnp_naive_no_two_leaders),
+    "LogMatching": (_py_log_matching, _jnp_log_matching),
+    "CommittedWithinLog": (_py_committed_within_log, _jnp_committed_within_log),
+}
+
+
+def py_invariant(name: str):
+    return REGISTRY[name][0]
+
+
+def jnp_invariant(name: str, bounds: Bounds):
+    return REGISTRY[name][1](bounds)

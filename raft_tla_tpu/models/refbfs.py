@@ -1,0 +1,112 @@
+"""Exhaustive BFS over the interpreter — the CPU oracle's checker loop.
+
+This is what TLC does (SURVEY §0): breadth-first exploration from ``Init``,
+invariants checked on every distinct state, CONSTRAINT gating expansion
+(violating states are counted but their successors are not generated), and a
+counterexample trace on invariant violation.  The TPU engine (engine.py) must
+reproduce its distinct-state count, diameter, and verdicts exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import Counter
+from typing import Optional
+
+from raft_tla_tpu.config import CheckConfig
+from raft_tla_tpu.models import interp, invariants, spec as S
+
+
+@dataclasses.dataclass
+class Violation:
+    invariant: str
+    state: interp.PyState
+    # Trace from Init to the violating state: [(action_label | None, state)].
+    trace: list
+
+
+@dataclasses.dataclass
+class RefResult:
+    n_states: int          # distinct states found (incl. constraint-violating)
+    diameter: int          # number of BFS levels past Init with new states
+    n_transitions: int     # enabled (state, action) pairs explored
+    coverage: Counter      # action family -> distinct new states produced
+    violation: Optional[Violation]
+    levels: list           # new-state count per level (levels[0] = 1 = Init)
+    wall_s: float
+
+
+def check(config: CheckConfig, max_states: int | None = None,
+          init_override: interp.PyState | None = None) -> RefResult:
+    """Run the oracle checker; stops at the first invariant violation.
+
+    ``init_override`` replaces ``Init`` (testing hook: start exploration from
+    a crafted state when the violation region is deep — the pure-Python oracle
+    enumerates ~30k states/s, so full-depth demos belong to the TPU engine).
+    """
+    bounds = config.bounds
+    table = S.action_table(bounds, config.spec)
+    invs = [(nm, invariants.py_invariant(nm)) for nm in config.invariants]
+    t0 = time.monotonic()
+
+    init = init_override if init_override is not None \
+        else interp.init_state(bounds)
+    seen = {init: None}          # state -> (parent_state, action_idx) | None
+    levels = [1]
+    coverage: Counter = Counter()
+    n_transitions = 0
+    violation = None
+
+    def make_violation(nm, s):
+        chain = []
+        cur = s
+        while cur is not None:
+            entry = seen[cur]
+            chain.append((table[entry[1]].label() if entry else None, cur))
+            cur = entry[0] if entry else None
+        chain.reverse()
+        return Violation(invariant=nm, state=s, trace=chain)
+
+    for nm, fn in invs:
+        if not fn(init, bounds):
+            violation = make_violation(nm, init)
+
+    frontier = [init] if violation is None else []
+    while frontier:
+        nxt = []
+        for s in frontier:
+            if not interp.constraint_ok(s, bounds):
+                continue  # counted, invariant-checked, but not expanded
+            for aidx, t in interp.successors(s, bounds, table):
+                n_transitions += 1
+                if t in seen:
+                    continue
+                seen[t] = (s, aidx)
+                coverage[table[aidx].family] += 1
+                for nm, fn in invs:
+                    if not fn(t, bounds):
+                        violation = make_violation(nm, t)
+                        break
+                if violation is not None:
+                    break
+                nxt.append(t)
+            if violation is not None:
+                break
+        if violation is not None:
+            break
+        if max_states is not None and len(seen) > max_states:
+            raise RuntimeError(f"state count exceeded {max_states}")
+        if nxt:
+            levels.append(len(nxt))
+        frontier = nxt
+
+    return RefResult(
+        n_states=len(seen),
+        diameter=len(levels) - 1,
+        n_transitions=n_transitions,
+        coverage=coverage,
+        violation=violation,
+        levels=levels,
+        wall_s=time.monotonic() - t0,
+    )

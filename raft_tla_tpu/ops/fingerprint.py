@@ -1,0 +1,64 @@
+"""64-bit state fingerprints — the dedup key (TLC's FP64 analog, SURVEY §2.8).
+
+TLC deduplicates states by a 64-bit fingerprint of the canonicalized value
+(probabilistically exact, with a reported collision bound).  This module plays
+that role for the tensor encoding: a canonical ``int32[W]`` state vector hashes
+to two independent 32-bit lanes, combined host-side into one ``uint64``.
+
+Scheme: two-lane *multilinear* hash + murmur3 finalizer.  Lane k computes
+``fmix32(seed_k + sum_w c_k[w] * state[w] mod 2^32)`` with per-position odd
+random constants ``c_k``.  The multilinear family is pairwise almost-universal
+(collision probability ~2^-32 per lane per pair); two independent lanes give
+~2^-64 per pair — the same regime TLC operates in.  The linear part is one
+elementwise multiply + reduction (TPU-friendly: no sequential dependency over
+W, unlike a rolling hash), and the fmix32 avalanche decorrelates lanes from
+the raw linear structure for use as a hash-table index.
+
+Bit-identical across backends: all arithmetic is uint32 wraparound, explicit
+dtypes everywhere, same constants (fixed PRNG seed) — NumPy host, jnp device,
+the Pallas kernel (ops/pallas_fp.py), and the C++ host store (native/) must
+all agree, because sharding routes states by fingerprint (SURVEY §2.8).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_SEED = 0x5AF7_0001
+_LANE_SEEDS = (np.uint32(0x9E3779B9), np.uint32(0x85EBCA77))
+
+
+def lane_constants(width: int) -> np.ndarray:
+    """Per-position odd uint32 multipliers, shape (2, width). Deterministic."""
+    rng = np.random.Generator(np.random.PCG64(_SEED))
+    c = rng.integers(0, 2**32, size=(2, width), dtype=np.uint32)
+    return c | np.uint32(1)  # odd => multiplication is invertible mod 2^32
+
+
+def _fmix32(h, xp):
+    """murmur3 32-bit finalizer (public domain avalanche function)."""
+    u = xp.uint32
+    h = h ^ (h >> u(16))
+    h = h * u(0x85EBCA6B)
+    h = h ^ (h >> u(13))
+    h = h * u(0xC2B2AE35)
+    h = h ^ (h >> u(16))
+    return h
+
+
+def fingerprint(vec, consts, xp):
+    """Canonical int32[..., W] -> (hi, lo) uint32 lanes, shape [...]."""
+    w = vec.astype(xp.uint32)
+    c1 = consts[0].astype(xp.uint32)
+    c2 = consts[1].astype(xp.uint32)
+    s1 = xp.sum(w * c1, axis=-1, dtype=xp.uint32)
+    s2 = xp.sum(w * c2, axis=-1, dtype=xp.uint32)
+    h1 = _fmix32(s1 + _LANE_SEEDS[0], xp)
+    h2 = _fmix32(s2 + _LANE_SEEDS[1], xp)
+    return h1, h2
+
+
+def to_u64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """Host-side combine: two uint32 lanes -> one uint64 key."""
+    return (np.asarray(hi, dtype=np.uint64) << np.uint64(32)) | np.asarray(
+        lo, dtype=np.uint64)

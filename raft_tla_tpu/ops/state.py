@@ -1,0 +1,173 @@
+"""Fixed-width tensor state schema — the L1 layer (SURVEY §7.0.1).
+
+The spec's 13 non-history variables (``raft.tla:50-86`` plus ``messages``,
+``raft.tla:32``) map to a struct of small int32 arrays; a whole state also
+round-trips to a flat ``int32[W]`` vector (the frontier storage / fingerprint
+form).  History variables (``elections`` ``raft.tla:39``, ``allLogs``
+``raft.tla:44``, ``voterLog`` ``raft.tla:77``) are proof-only — read by no
+guard — and are stripped in parity mode (SURVEY §7.0.3).
+
+Struct fields (n = servers, L = log capacity, S = message slots):
+
+==============  ========  =====================================================
+field           shape     spec variable
+==============  ========  =====================================================
+role            (n,)      ``state``        (raft.tla:52)  0/1/2 = F/C/L
+term            (n,)      ``currentTerm``  (raft.tla:50)
+votedFor        (n,)      ``votedFor``     (raft.tla:55)  0 = Nil, else id+1
+commitIndex     (n,)      ``commitIndex``  (raft.tla:63)
+logLen          (n,)      ``Len(log[i])``  (raft.tla:61)
+logTerm         (n, L)    ``log[i][k].term``  (1-based k -> column k-1)
+logVal          (n, L)    ``log[i][k].value``  (values 1..V; 0 = no entry)
+vResp           (n,)      ``votesResponded`` (raft.tla:69) as bitmask
+vGrant          (n,)      ``votesGranted``   (raft.tla:72) as bitmask
+nextIndex       (n, n)    ``nextIndex``    (raft.tla:82)
+matchIndex      (n, n)    ``matchIndex``   (raft.tla:85)
+msgHi/Lo/Count  (S,)      the ``messages`` bag (raft.tla:32), ops/msgbits.py
+==============  ========  =====================================================
+
+Canonical form (required before fingerprinting — the bag is unordered, and
+sequences are padded):
+
+- message slots sorted by (occupied-first, hi, lo); empty slots are all-zero;
+- log columns >= logLen[i] are zero;
+- everything else is canonical by construction (bitmask sets, dense arrays).
+
+All transition kernels preserve canonical zero-padding functionally, and
+:func:`canonicalize` restores slot order after bag mutations.
+
+The module is dual-backend: every function takes the array namespace ``xp``
+(``numpy`` or ``jax.numpy``) so the host oracle and the device kernels share
+one implementation, bit for bit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.models.spec import FOLLOWER, NIL
+
+STATE_FIELDS = ("role", "term", "votedFor", "commitIndex", "logLen",
+                "logTerm", "logVal", "vResp", "vGrant",
+                "nextIndex", "matchIndex", "msgHi", "msgLo", "msgCount")
+
+
+@dataclasses.dataclass(frozen=True)
+class Layout:
+    """Shapes and flat-vector offsets for a bounds instance."""
+
+    n: int
+    L: int
+    S: int
+
+    @classmethod
+    def of(cls, bounds: Bounds) -> "Layout":
+        return cls(n=bounds.n_servers, L=bounds.log_cap, S=bounds.msg_cap)
+
+    @property
+    def shapes(self) -> dict:
+        n, L, S = self.n, self.L, self.S
+        return {
+            "role": (n,), "term": (n,), "votedFor": (n,),
+            "commitIndex": (n,), "logLen": (n,),
+            "logTerm": (n, L), "logVal": (n, L),
+            "vResp": (n,), "vGrant": (n,),
+            "nextIndex": (n, n), "matchIndex": (n, n),
+            "msgHi": (S,), "msgLo": (S,), "msgCount": (S,),
+        }
+
+    @property
+    def width(self) -> int:
+        return sum(int(np.prod(s)) for s in self.shapes.values())
+
+
+def init_struct(bounds: Bounds, xp):
+    """The unique initial state (``Init``, ``raft.tla:155-160``).
+
+    currentTerm = 1, state = Follower, votedFor = Nil (``raft.tla:143-145``);
+    empty vote sets (``raft.tla:146-147``); nextIndex = 1, matchIndex = 0
+    (``raft.tla:151-152``); empty logs, commitIndex = 0 (``raft.tla:153-154``);
+    empty message bag (``raft.tla:155``).
+    """
+    lay = Layout.of(bounds)
+    n, L, S = lay.n, lay.L, lay.S
+    i32 = xp.int32
+    return {
+        "role": xp.full((n,), FOLLOWER, dtype=i32),
+        "term": xp.ones((n,), dtype=i32),
+        "votedFor": xp.full((n,), NIL, dtype=i32),
+        "commitIndex": xp.zeros((n,), dtype=i32),
+        "logLen": xp.zeros((n,), dtype=i32),
+        "logTerm": xp.zeros((n, L), dtype=i32),
+        "logVal": xp.zeros((n, L), dtype=i32),
+        "vResp": xp.zeros((n,), dtype=i32),
+        "vGrant": xp.zeros((n,), dtype=i32),
+        "nextIndex": xp.ones((n, n), dtype=i32),
+        "matchIndex": xp.zeros((n, n), dtype=i32),
+        "msgHi": xp.zeros((S,), dtype=i32),
+        "msgLo": xp.zeros((S,), dtype=i32),
+        "msgCount": xp.zeros((S,), dtype=i32),
+    }
+
+
+def pack(struct, xp):
+    """Struct -> flat int32[W] vector (field order = STATE_FIELDS)."""
+    return xp.concatenate([xp.reshape(struct[f], (-1,)) for f in STATE_FIELDS])
+
+
+def unpack(vec, lay: Layout, xp):
+    """Flat int32[W] vector -> struct."""
+    out, off = {}, 0
+    for f, shape in lay.shapes.items():
+        size = int(np.prod(shape))
+        out[f] = xp.reshape(vec[off:off + size], shape).astype(xp.int32)
+        off += size
+    return out
+
+
+def canonicalize(struct, xp):
+    """Sort message slots into canonical order: occupied first, then (hi, lo).
+
+    The bag is an unordered function (``raft.tla:32``); slot order is an
+    encoding artifact and must not influence the fingerprint.  Distinct
+    occupied slots always differ in (hi, lo) — the bag merges equal messages
+    into one multiplicity (``WithMessage``, ``raft.tla:106-110``) — so the
+    sort is a total order and canonicalization is unique.
+    """
+    occupied = struct["msgCount"] > 0
+    # Enforce, not just assume, the all-zero empty-slot form: a kernel that
+    # decrements a count to 0 may leave stale content words behind, which
+    # would split fingerprints of identical bags.
+    hi = xp.where(occupied, struct["msgHi"], 0)
+    lo = xp.where(occupied, struct["msgLo"], 0)
+    ct = xp.where(occupied, struct["msgCount"], 0)
+    perm = xp.lexsort((lo, hi, (~occupied).astype(xp.int32)))
+    out = dict(struct)
+    out["msgHi"] = hi[perm]
+    out["msgLo"] = lo[perm]
+    out["msgCount"] = ct[perm]
+    return out
+
+
+def occupied_slots(struct, xp):
+    """Mask of slots holding a bag element (``m \\in DOMAIN messages``)."""
+    return struct["msgCount"] > 0
+
+
+def constraint_ok(struct, bounds: Bounds, xp):
+    """The StateConstraint (SURVEY §0 defect 2): scalar bool.
+
+    ``/\\ \\A i : currentTerm[i] <= MaxTerm /\\ Len(log[i]) <= MaxLogLen
+    /\\ Cardinality(DOMAIN messages) <= MaxMsgs /\\ \\A m : messages[m] <= MaxDup``
+
+    States violating it are counted and invariant-checked but not expanded —
+    TLC CONSTRAINT semantics.
+    """
+    return (xp.all(struct["term"] <= bounds.max_term)
+            & xp.all(struct["logLen"] <= bounds.max_log)
+            & (xp.sum((struct["msgCount"] > 0).astype(xp.int32))
+               <= bounds.max_msgs)
+            & xp.all(struct["msgCount"] <= bounds.max_dup))

@@ -1,0 +1,139 @@
+"""Parser for TLC model config files (the L5 layer).
+
+Byte-compatible with the reference's ``raft.cfg:1-15``, whose grammar is:
+
+- ``SPECIFICATION Spec``            (``raft.cfg:1``)
+- ``INVARIANT NoTwoLeaders``        (``raft.cfg:3``)
+- ``CONSTANTS`` followed by indented ``Name = binding`` lines with optional
+  ``\\*`` end-of-line comments (``raft.cfg:5-15``), where a binding is either
+  a model value (``Follower = "Follower"`` / ``Nil = Nil``) or a finite set
+  (``Server = {s1, s2, s3}``).
+
+Additionally understood (the TLC stanzas the reference does not use but the
+checker supports): ``INVARIANTS``, ``CONSTRAINT``, ``PROPERTY``,
+``CONSTANT`` (singular), so configs written for stock TLC parse unchanged.
+
+The parsed cfg is mapped onto the built-in compiled Raft model: the cardinality
+of ``Server``/``Value`` becomes :class:`raft_tla_tpu.config.Bounds`
+``n_servers``/``n_values``; invariant names resolve against the invariant
+registry.  Bound parameters (MaxTerm &c.) come from CLI/:class:`Bounds`, and
+``models/tla_export.py`` emits the matching ``CONSTRAINT`` module for stock
+TLC parity runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+_STANZAS = (
+    "SPECIFICATION",
+    "INVARIANTS",
+    "INVARIANT",
+    "CONSTANTS",
+    "CONSTANT",
+    "CONSTRAINTS",
+    "CONSTRAINT",
+    "PROPERTIES",
+    "PROPERTY",
+    "INIT",
+    "NEXT",
+    "SYMMETRY",
+    "VIEW",
+)
+
+
+@dataclasses.dataclass
+class TLCConfig:
+    specification: str | None = None
+    init: str | None = None
+    next: str | None = None
+    invariants: list[str] = dataclasses.field(default_factory=list)
+    properties: list[str] = dataclasses.field(default_factory=list)
+    constraints: list[str] = dataclasses.field(default_factory=list)
+    # Name -> python value: list[str] for set bindings, str for model values.
+    constants: dict = dataclasses.field(default_factory=dict)
+    symmetry: list[str] = dataclasses.field(default_factory=list)
+
+    def server_names(self) -> list[str]:
+        v = self.constants.get("Server")
+        if not isinstance(v, list):
+            raise ValueError("cfg does not bind Server to a finite set")
+        return v
+
+    def value_names(self) -> list[str]:
+        v = self.constants.get("Value")
+        if not isinstance(v, list):
+            raise ValueError("cfg does not bind Value to a finite set")
+        return v
+
+
+def _strip_comment(line: str) -> str:
+    # TLA+ end-of-line comment: \* ... (also tolerate (* ... *) on one line)
+    line = re.sub(r"\(\*.*?\*\)", " ", line)
+    idx = line.find("\\*")
+    if idx >= 0:
+        line = line[:idx]
+    return line.strip()
+
+
+def _parse_set(text: str) -> list[str]:
+    inner = text.strip()
+    if not (inner.startswith("{") and inner.endswith("}")):
+        raise ValueError(f"not a set literal: {text!r}")
+    body = inner[1:-1].strip()
+    if not body:
+        return []
+    toks = [tok.strip() for tok in body.split(",")]
+    if any(not t for t in toks):
+        raise ValueError(f"empty element in set literal: {text!r}")
+    return toks
+
+
+def parse_cfg(text: str) -> TLCConfig:
+    cfg = TLCConfig()
+    mode: str | None = None
+    for raw in text.splitlines():
+        line = _strip_comment(raw)
+        if not line:
+            continue
+        # A stanza keyword may start the line, optionally with an inline value
+        # (separated by any whitespace — stock TLC accepts tabs too).
+        parts = line.split(None, 1)
+        if parts[0] in _STANZAS:
+            mode = parts[0]
+            line = parts[1].strip() if len(parts) > 1 else ""
+            if not line:
+                continue
+        if mode in ("SPECIFICATION",):
+            cfg.specification = line
+        elif mode == "INIT":
+            cfg.init = line
+        elif mode == "NEXT":
+            cfg.next = line
+        elif mode in ("INVARIANT", "INVARIANTS"):
+            cfg.invariants.extend(line.split())
+        elif mode in ("PROPERTY", "PROPERTIES"):
+            cfg.properties.extend(line.split())
+        elif mode in ("CONSTRAINT", "CONSTRAINTS"):
+            cfg.constraints.extend(line.split())
+        elif mode == "SYMMETRY":
+            cfg.symmetry.extend(line.split())
+        elif mode in ("CONSTANT", "CONSTANTS"):
+            if "=" not in line:
+                raise ValueError(f"bad CONSTANTS binding: {raw!r}")
+            name, _, val = line.partition("=")
+            name, val = name.strip(), val.strip()
+            # "<-" substitutions are not supported (not used by the reference).
+            if val.startswith("{"):
+                cfg.constants[name] = _parse_set(val)
+            else:
+                cfg.constants[name] = val.strip('"')
+        else:
+            raise ValueError(f"line outside any stanza: {raw!r}")
+    return cfg
+
+
+def load_cfg(path: str) -> TLCConfig:
+    with open(path, "r", encoding="utf-8") as f:
+        return parse_cfg(f.read())

@@ -1,0 +1,267 @@
+"""Interpreter unit tests — one per guard/effect branch (SURVEY §4.1).
+
+Covers the corner semantics called out in SURVEY §2.5/§2.6: self-vote via the
+network, UpdateTerm leaving the message in flight, candidate step-down keeping
+the message, truncate-one-off-the-tail, commitIndex decrease on stale
+requests, and the nextIndex floor.
+"""
+
+import numpy as np
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.models import interp, refbfs, spec as S
+from raft_tla_tpu.ops import msgbits as mb
+
+B = Bounds(n_servers=3, n_values=2, max_term=3, max_log=2, max_msgs=4)
+N = B.n_servers
+
+
+def bag(*items):
+    d = {}
+    for m in items:
+        d[m] = d.get(m, 0) + 1
+    return tuple(sorted(d.items()))
+
+
+def test_init_matches_spec():
+    s = interp.init_state(B)
+    assert s.term == (1, 1, 1)
+    assert s.role == (S.FOLLOWER,) * 3
+    assert s.nextIndex == ((1, 1, 1),) * 3
+    assert s.msgs == ()
+
+
+def test_timeout_no_self_vote():
+    """Timeout (raft.tla:178-187): votedFor stays Nil; self-vote is by message."""
+    s = interp.init_state(B)
+    t = interp.timeout(s, 0)
+    assert t.role[0] == S.CANDIDATE and t.term[0] == 2
+    assert t.votedFor[0] == S.NIL
+    # leader cannot time out
+    lead = s._replace(role=(S.LEADER, 0, 0))
+    assert interp.timeout(lead, 0) is None
+
+
+def test_request_vote_self_allowed():
+    """RequestVote quantifies over all pairs incl. i=j (raft.tla:456)."""
+    s = interp.timeout(interp.init_state(B), 0)
+    t = interp.request_vote(s, 0, 0)
+    assert t is not None
+    ((hi, _lo), cnt), = t.msgs
+    assert mb.mtype(hi) == S.M_RVREQ and mb.src(hi) == 0 and mb.dst(hi) == 0
+    assert cnt == 1
+    # repeated send of identical message bumps multiplicity (WithMessage :106-110)
+    t2 = interp.request_vote(t, 0, 0)
+    assert t2.msgs[0][1] == 2
+
+
+def test_update_term_keeps_message():
+    """UpdateTerm (raft.tla:406-412): message NOT consumed, reprocessed later."""
+    s = interp.init_state(B)
+    m = mb.rv_request(3, 0, 0, 1, 0)
+    s = s._replace(msgs=bag(m))
+    t = interp.receive(s, 0)
+    assert t.term[0] == 3 and t.role[0] == S.FOLLOWER
+    assert t.msgs == s.msgs
+    # Re-receive now dispatches the RV request handler (grant, term equal).
+    t2 = interp.receive(t, 0)
+    assert t2.votedFor[0] == 2  # voted for server 1 (id+1 encoding)
+    (mm, cnt), = t2.msgs
+    assert mb.mtype(mm[0]) == S.M_RVRESP and mb.fa(mm[0]) == 1
+
+
+def test_vote_denied_when_log_stale():
+    """logOk (raft.tla:285-287): deny when candidate's log is behind."""
+    s = interp.init_state(B)
+    s = s._replace(log=(((1, 1),), (), ()))  # server 0 has one entry
+    m = mb.rv_request(1, 0, 0, 1, 0)         # candidate 1, empty log, term 1
+    s = s._replace(msgs=bag(m))
+    t = interp.receive(s, 0)
+    (mm, _), = t.msgs
+    assert mb.mtype(mm[0]) == S.M_RVRESP and mb.fa(mm[0]) == 0  # not granted
+    assert t.votedFor[0] == S.NIL
+
+
+def test_vote_response_tally_and_stale_drop():
+    s = interp.timeout(interp.init_state(B), 0)  # candidate, term 2
+    granted = mb.rv_response(2, 1, 1, 0)
+    stale = mb.rv_response(1, 1, 2, 0)
+    s = s._replace(msgs=bag(granted, stale))
+    slot_granted = [k for k, (m, _) in enumerate(s.msgs) if m == granted][0]
+    t = interp.receive(s, slot_granted)
+    assert t.vResp[0] & (1 << 1) and t.vGrant[0] & (1 << 1)
+    slot_stale = [k for k, (m, _) in enumerate(t.msgs) if m == stale][0]
+    u = interp.receive(t, slot_stale)  # DropStaleResponse (raft.tla:415-418)
+    assert all(m != stale for m, _ in u.msgs)
+    assert u.vResp == t.vResp and u.vGrant == t.vGrant
+
+
+def test_become_leader_quorum():
+    s = interp.timeout(interp.init_state(B), 0)
+    s = s._replace(vGrant=(0b011, 0, 0))  # votes from 0 and 1: quorum of 3
+    t = interp.become_leader(s, 0, N)
+    assert t.role[0] == S.LEADER
+    assert t.nextIndex[0] == (1, 1, 1)  # Len(log)+1 (raft.tla:233-234)
+    s2 = s._replace(vGrant=(0b001, 0, 0))
+    assert interp.become_leader(s2, 0, N) is None
+
+
+def test_candidate_step_down_keeps_message():
+    """HandleAppendEntriesRequest branch b (raft.tla:346-350)."""
+    s = interp.init_state(B)
+    s = s._replace(role=(S.CANDIDATE, S.LEADER, S.FOLLOWER), term=(2, 2, 1))
+    m = mb.ae_request(2, 0, 0, 0, 0, 0, 0, 1, 0)  # heartbeat leader 1 -> 0
+    s = s._replace(msgs=bag(m))
+    t = interp.receive(s, 0)
+    assert t.role[0] == S.FOLLOWER
+    assert t.msgs == s.msgs  # kept for reprocessing
+
+
+def test_append_then_done_then_commit_decrease():
+    """Accept branches (raft.tla:356-388) incl. commitIndex decrease."""
+    s = interp.init_state(B)
+    s = s._replace(role=(S.FOLLOWER, S.LEADER, S.FOLLOWER), term=(2, 2, 1),
+                   log=((), ((2, 1),), ()))
+    m = mb.ae_request(2, 0, 0, 1, 2, 1, 0, 1, 0)
+    s = s._replace(msgs=bag(m))
+    t = interp.receive(s, 0)          # no conflict: append (raft.tla:383-388)
+    assert t.log[0] == ((2, 1),)
+    assert t.msgs == s.msgs           # message kept
+    u = interp.receive(t, 0)          # already done: reply (raft.tla:356-374)
+    assert u.commitIndex[0] == 0
+    (mm, _), = u.msgs
+    assert mb.mtype(mm[0]) == S.M_AERESP and mb.fa(mm[0]) == 1
+    assert mb.fb(mm[0]) == 1          # mmatchIndex = prevLogIndex + Len(entries)
+    # commitIndex decrease: set commit to 1, then receive stale dup with mcommit 0
+    v = u._replace(commitIndex=(1, 0, 0), msgs=bag(m))
+    w = interp.receive(v, 0)
+    assert w.commitIndex[0] == 0      # decreased (raft.tla:361-365)
+
+
+def test_conflict_truncates_tail():
+    """Conflict removes ONE entry off the tail, not at index (raft.tla:375-382)."""
+    s = interp.init_state(B)
+    s = s._replace(role=(S.FOLLOWER, S.LEADER, S.FOLLOWER), term=(3, 3, 1),
+                   log=(((1, 1), (1, 2)), ((3, 2),), ()))
+    m = mb.ae_request(3, 0, 0, 1, 3, 2, 0, 1, 0)  # entry term 3 conflicts @1
+    s = s._replace(msgs=bag(m))
+    t = interp.receive(s, 0)
+    assert t.log[0] == ((1, 1),)      # tail entry removed
+    assert t.msgs == s.msgs           # kept: multi-step convergence loop
+
+
+def test_reject_stale_term():
+    s = interp.init_state(B)
+    s = s._replace(term=(3, 1, 1))
+    m = mb.ae_request(1, 0, 0, 0, 0, 0, 0, 1, 0)
+    s = s._replace(msgs=bag(m))
+    t = interp.receive(s, 0)
+    (mm, _), = t.msgs
+    assert mb.mtype(mm[0]) == S.M_AERESP
+    assert mb.fa(mm[0]) == 0 and mb.mterm(mm[0]) == 3
+
+
+def test_ae_response_next_index_floor():
+    """HandleAppendEntriesResponse failure path: Max(nextIndex-1, 1) (:399-400)."""
+    s = interp.init_state(B)
+    s = s._replace(role=(S.LEADER, 0, 0), term=(2, 2, 1))
+    fail = mb.ae_response(2, 0, 0, 1, 0)
+    s = s._replace(msgs=bag(fail))
+    t = interp.receive(s, 0)
+    assert t.nextIndex[0][1] == 1     # floor holds at 1
+    ok = mb.ae_response(2, 1, 2, 1, 0)
+    u = t._replace(msgs=bag(ok))
+    v = interp.receive(u, 0)
+    assert v.nextIndex[0][1] == 3 and v.matchIndex[0][1] == 2
+
+
+def test_advance_commit_current_term_restriction():
+    """AdvanceCommitIndex (raft.tla:268-270): only current-term entries commit."""
+    s = interp.init_state(B)
+    s = s._replace(role=(S.LEADER, 0, 0), term=(2, 1, 1),
+                   log=(((1, 1),), (), ()),
+                   matchIndex=((0, 1, 1), (0,) * 3, (0,) * 3))
+    t = interp.advance_commit_index(s, 0, N)
+    assert t.commitIndex[0] == 0      # term-1 entry, leader at term 2
+    s2 = s._replace(log=(((2, 1),), (), ()))
+    t2 = interp.advance_commit_index(s2, 0, N)
+    assert t2.commitIndex[0] == 1
+
+
+def test_restart_keeps_stable_storage():
+    s = interp.init_state(B)
+    s = s._replace(role=(S.LEADER, 0, 0), term=(3, 1, 1), votedFor=(1, 0, 0),
+                   log=(((2, 1),), (), ()), commitIndex=(1, 0, 0),
+                   vGrant=(0b111, 0, 0), nextIndex=((2, 2, 2),) + ((1,) * 3,) * 2)
+    t = interp.restart(s, 0, N)
+    assert t.role[0] == S.FOLLOWER
+    assert t.term[0] == 3 and t.votedFor[0] == 1 and t.log[0] == ((2, 1),)
+    assert t.commitIndex[0] == 0 and t.vGrant[0] == 0
+    assert t.nextIndex[0] == (1, 1, 1) and t.matchIndex[0] == (0, 0, 0)
+
+
+def test_duplicate_and_drop():
+    s = interp.init_state(B)
+    m = mb.rv_request(1, 0, 0, 0, 1)
+    s = s._replace(msgs=bag(m))
+    d = interp.duplicate_message(s, 0)
+    assert d.msgs[0][1] == 2
+    e = interp.drop_message(d, 0)
+    assert e.msgs == s.msgs
+    f = interp.drop_message(e, 0)
+    assert f.msgs == ()
+    assert interp.drop_message(f, 0) is None  # empty bag: no slot
+
+
+def test_bfs_election_tiny():
+    """Exhaustive election-only run, 2 servers: spot-check determinism."""
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",))
+    r1 = refbfs.check(cfg)
+    r2 = refbfs.check(cfg)
+    assert r1.violation is None
+    assert r1.n_states == r2.n_states and r1.diameter == r2.diameter
+    assert r1.n_states > 10
+
+
+def test_bfs_naive_invariant_violated_with_trace():
+    """The naive reading is falsified and yields a replayable trace (§0.1).
+
+    A deposed leader keeps state = Leader until it observes the higher term
+    (raft.tla:406-412), so two simultaneous leaders in different terms are
+    reachable.  The violation region is ~18 steps deep, beyond the
+    pure-Python oracle's reach, so exploration starts from a crafted
+    mid-election state: s1 leads term 2; s3 campaigns in term 3 with s2's
+    vote still in flight.
+    """
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=4, max_dup=1)
+    cfg = CheckConfig(bounds=bounds, spec="election",
+                      invariants=("NaiveNoTwoLeaders",))
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3),
+        votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100),
+        msgs=bag(mb.rv_response(3, 1, 1, 2)),  # s2's grant to s3, in flight
+    )
+    r = refbfs.check(cfg, init_override=start)
+    assert r.violation is not None
+    trace = r.violation.trace
+    assert trace[0][0] is None and trace[0][1] == start
+    # each step is a real successor of its predecessor
+    for (_lbl, prev), (_label, cur) in zip(trace, trace[1:]):
+        succs = [t for _i, t in interp.successors(prev, bounds,
+                                                  spec="election")]
+        assert cur in succs
+    # final state has two simultaneous leaders, in different terms
+    final = trace[-1][1]
+    leaders = [i for i, x in enumerate(final.role) if x == S.LEADER]
+    assert len(leaders) >= 2
+    assert len({final.term[i] for i in leaders}) == len(leaders)
+    # ...but ElectionSafety holds throughout this run
+    r2 = refbfs.check(CheckConfig(bounds=bounds, spec="election",
+                                  invariants=("NoTwoLeaders",)),
+                      init_override=start)
+    assert r2.violation is None

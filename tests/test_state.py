@@ -1,0 +1,140 @@
+"""State schema: pack/unpack round-trip, canonicalization, fingerprints."""
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.models import interp
+from raft_tla_tpu.ops import fingerprint as fp
+from raft_tla_tpu.ops import msgbits as mb
+from raft_tla_tpu.ops import state as st
+
+B = Bounds(n_servers=3, n_values=2, max_term=3, max_log=2, max_msgs=4)
+
+
+def random_pystate(rng, bounds: Bounds) -> interp.PyState:
+    """Arbitrary bounded (not necessarily reachable) state, canonical."""
+    n, V = bounds.n_servers, bounds.n_values
+    logs = []
+    for _ in range(n):
+        ln = rng.integers(0, bounds.log_cap + 1)
+        logs.append(tuple(
+            (int(rng.integers(1, bounds.term_cap + 1)),
+             int(rng.integers(1, V + 1))) for _ in range(ln)))
+    msgs = {}
+    for _ in range(rng.integers(0, bounds.msg_cap + 1)):
+        mt = int(rng.integers(1, 5))
+        term = int(rng.integers(1, bounds.term_cap + 1))
+        i, j = int(rng.integers(0, n)), int(rng.integers(0, n))
+        if mt == 1:
+            m = mb.rv_request(term, int(rng.integers(0, bounds.term_cap + 1)),
+                              int(rng.integers(0, bounds.log_cap + 1)), i, j)
+        elif mt == 2:
+            m = mb.rv_response(term, int(rng.integers(0, 2)), i, j)
+        elif mt == 3:
+            ne = int(rng.integers(0, 2))
+            m = mb.ae_request(term, int(rng.integers(0, bounds.log_cap + 1)),
+                              int(rng.integers(0, bounds.term_cap + 1)),
+                              ne, ne * int(rng.integers(1, bounds.term_cap + 1)),
+                              ne * int(rng.integers(1, V + 1)),
+                              int(rng.integers(0, bounds.log_cap + 1)), i, j)
+        else:
+            m = mb.ae_response(term, int(rng.integers(0, 2)),
+                               int(rng.integers(0, bounds.log_cap + 1)), i, j)
+        msgs[m] = int(rng.integers(1, bounds.dup_cap + 1))
+    return interp.PyState(
+        role=tuple(int(x) for x in rng.integers(0, 3, n)),
+        term=tuple(int(x) for x in rng.integers(1, bounds.term_cap + 1, n)),
+        votedFor=tuple(int(x) for x in rng.integers(0, n + 1, n)),
+        commitIndex=tuple(int(rng.integers(0, len(l) + 1)) for l in logs),
+        log=tuple(logs),
+        vResp=tuple(int(x) for x in rng.integers(0, 2**n, n)),
+        vGrant=tuple(int(x) for x in rng.integers(0, 2**n, n)),
+        nextIndex=tuple(tuple(int(x) for x in rng.integers(1, bounds.log_cap + 2, n))
+                        for _ in range(n)),
+        matchIndex=tuple(tuple(int(x) for x in rng.integers(0, bounds.log_cap + 1, n))
+                         for _ in range(n)),
+        msgs=tuple(sorted(msgs.items())),
+    )
+
+
+def test_msgbits_roundtrip():
+    hi, lo = mb.ae_request(5, 3, 2, 1, 4, 2, 1, 2, 0)
+    assert mb.mtype(hi) == 3
+    assert mb.mterm(hi) == 5
+    assert mb.fa(hi) == 3 and mb.fb(hi) == 2
+    assert mb.src(hi) == 2 and mb.dst(hi) == 0
+    assert mb.fc(lo) == 1 and mb.fd(lo) == 4 and mb.fe(lo) == 2 and mb.ff(lo) == 1
+
+
+def test_layout_width():
+    lay = st.Layout.of(B)
+    n, L, S = lay.n, lay.L, lay.S
+    assert lay.width == 7 * n + 2 * n * L + 2 * n * n + 3 * S
+
+
+def test_pystate_struct_vec_roundtrip():
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        s = random_pystate(rng, B)
+        struct = interp.to_struct(s, B)
+        assert interp.from_struct(struct, B) == s
+        vec = st.pack(struct, np)
+        assert vec.shape == (st.Layout.of(B).width,)
+        back = st.unpack(vec, st.Layout.of(B), np)
+        assert interp.from_struct(back, B) == s
+
+
+def test_canonicalize_is_sort_invariant():
+    rng = np.random.default_rng(1)
+    s = random_pystate(rng, B)
+    while len(s.msgs) < 2:
+        s = random_pystate(rng, B)
+    struct = interp.to_struct(s, B)
+    # scramble slot order (including moving empties to the front)
+    perm = rng.permutation(st.Layout.of(B).S)
+    scrambled = dict(struct)
+    for f in ("msgHi", "msgLo", "msgCount"):
+        scrambled[f] = struct[f][perm]
+    canon = st.canonicalize(scrambled, np)
+    np.testing.assert_array_equal(canon["msgHi"], struct["msgHi"])
+    np.testing.assert_array_equal(canon["msgLo"], struct["msgLo"])
+    np.testing.assert_array_equal(canon["msgCount"], struct["msgCount"])
+
+
+def test_init_struct_matches_interp():
+    want = interp.to_struct(interp.init_state(B), B)
+    got = st.init_struct(B, np)
+    for f in st.STATE_FIELDS:
+        np.testing.assert_array_equal(got[f], want[f], err_msg=f)
+
+
+def test_fingerprint_np_jnp_bit_identical():
+    import jax.numpy as jnp
+    lay = st.Layout.of(B)
+    consts = fp.lane_constants(lay.width)
+    rng = np.random.default_rng(2)
+    vecs = np.stack([interp.to_vec(random_pystate(rng, B), B)
+                     for _ in range(64)])
+    h1n, h2n = fp.fingerprint(vecs, consts, np)
+    h1j, h2j = fp.fingerprint(jnp.asarray(vecs), jnp.asarray(consts), jnp)
+    np.testing.assert_array_equal(h1n, np.asarray(h1j))
+    np.testing.assert_array_equal(h2n, np.asarray(h2j))
+    # distinct states should fingerprint distinctly (64 random states)
+    u = fp.to_u64(h1n, h2n)
+    assert len(np.unique(u)) == len(np.unique(vecs, axis=0))
+
+
+def test_constraint_ok_agrees():
+    rng = np.random.default_rng(3)
+    for _ in range(100):
+        s = random_pystate(rng, B)
+        assert bool(st.constraint_ok(interp.to_struct(s, B), B, np)) == \
+            interp.constraint_ok(s, B)
+
+
+def test_bounds_validation():
+    with pytest.raises(ValueError):
+        Bounds(n_servers=20)
+    with pytest.raises(ValueError):
+        Bounds(max_term=64)
