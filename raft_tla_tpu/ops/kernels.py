@@ -1,0 +1,473 @@
+"""Batched transition kernels — the L2 layer as branchless tensor ops.
+
+Each of the spec's 10 action families (``Next`` disjuncts, ``raft.tla:454-463``)
+and 7 message handlers (``raft.tla:284-418``) becomes a guarded functional
+update on the tensor struct (ops/state.py).  :func:`build_expand` assembles
+them into one jittable ``state -> (successors, valid, overflow)`` function with
+the static fan-out of models/spec.py's action table; the engine vmaps it over
+the frontier.
+
+Design rules (SURVEY §7):
+
+- **No data-dependent control flow.**  Every disjunct/branch computes its
+  guard as a boolean and its effect unconditionally; ``jnp.where`` selects.
+  Handler guards partition on ``mterm`` vs ``currentTerm`` (SURVEY §3.3), so
+  the per-message dispatch is a branchless select over mutually exclusive
+  masks.
+- **Effects are functional one-hot updates** (``x.at[]`` is avoided in favor
+  of mask arithmetic so the same code vmaps over action parameters).
+- **Messages survive or die exactly as in the spec**: UpdateTerm, candidate
+  step-down, conflict-truncate and append all *keep* the request in the bag
+  (``raft.tla:411-412, 350, 382, 388``) — the multi-step convergence loop must
+  not be fused (SURVEY §2.6).
+- **Capacity overflow is loud**: ``bag_add`` reports when no slot is free;
+  the engine asserts the flag never fires for states it expands (the +1
+  capacity scheme of config.py makes that a theorem, the flag checks it).
+
+The differential test (tests/test_kernels.py) compares every successor lane
+against the reference interpreter on random bounded states and on reachable
+prefixes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.models import spec as SP
+from raft_tla_tpu.ops import msgbits as mb
+from raft_tla_tpu.ops import state as st
+from raft_tla_tpu.ops import fingerprint as fpr
+
+I32 = jnp.int32
+
+
+def _popcount(x):
+    """Branchless 32-bit popcount (Quorum test, ``raft.tla:99``)."""
+    x = x - ((x >> 1) & 0x55555555)
+    x = (x & 0x33333333) + ((x >> 2) & 0x33333333)
+    x = (x + (x >> 4)) & 0x0F0F0F0F
+    return (x * 0x01010101) >> 24
+
+
+def _onehot(i, n):
+    return jnp.arange(n) == i
+
+
+def _set1(arr, i, val):
+    """arr with arr[i] = val (one-hot form, vmappable over traced i)."""
+    return jnp.where(_onehot(i, arr.shape[0]), val, arr)
+
+
+def _set_row(mat, i, val):
+    """mat with row i set to the scalar val."""
+    return jnp.where(_onehot(i, mat.shape[0])[:, None], val, mat)
+
+
+def _set2(mat, i, j, val):
+    mask = _onehot(i, mat.shape[0])[:, None] & _onehot(j, mat.shape[1])[None, :]
+    return jnp.where(mask, val, mat)
+
+
+def _last_term(s, i):
+    """``LastTerm(log[i])`` (raft.tla:102)."""
+    ln = s["logLen"][i]
+    idx = jnp.clip(ln - 1, 0, s["logTerm"].shape[1] - 1)
+    return jnp.where(ln > 0, s["logTerm"][i, idx], 0)
+
+
+# -- bag operations (raft.tla:106-130) ---------------------------------------
+
+def bag_add(s, hi, lo):
+    """``WithMessage`` (raft.tla:106-110). Returns (struct', overflow)."""
+    H, L, C = s["msgHi"], s["msgLo"], s["msgCount"]
+    match = (H == hi) & (L == lo) & (C > 0)
+    exists = jnp.any(match)
+    empty = C == 0
+    has_empty = jnp.any(empty)
+    first_empty = jnp.argmax(empty)  # index of first empty slot
+    ins = (~exists) & has_empty & _onehot(first_empty, C.shape[0]) & empty
+    out = dict(s)
+    out["msgHi"] = jnp.where(ins, hi, H).astype(I32)
+    out["msgLo"] = jnp.where(ins, lo, L).astype(I32)
+    out["msgCount"] = (C + match.astype(I32) + ins.astype(I32)).astype(I32)
+    return out, (~exists) & (~has_empty)
+
+
+def bag_remove(s, hi, lo):
+    """``WithoutMessage`` (raft.tla:114-119); no-op when absent."""
+    H, L, C = s["msgHi"], s["msgLo"], s["msgCount"]
+    match = (H == hi) & (L == lo) & (C > 0)
+    c2 = C - match.astype(I32)
+    emptied = match & (c2 == 0)
+    out = dict(s)
+    out["msgHi"] = jnp.where(emptied, 0, H).astype(I32)
+    out["msgLo"] = jnp.where(emptied, 0, L).astype(I32)
+    out["msgCount"] = c2.astype(I32)
+    return out
+
+
+def reply(s, resp_hi, resp_lo, req_hi, req_lo):
+    """``Reply`` (raft.tla:129-130): WithoutMessage(request, WithMessage(resp)).
+
+    Evaluated remove-first: request and response always differ (mtype), so
+    the two bag edits commute, and removing first avoids claiming a transient
+    extra slot — overflow then fires iff the *final* bag exceeds capacity.
+    """
+    out = bag_remove(s, req_hi, req_lo)
+    out, ovf = bag_add(out, resp_hi, resp_lo)
+    return out, ovf
+
+
+def _tree_select(branches, default):
+    """Select among (guard, struct) branches; guards must be exclusive."""
+    out = default
+    for g, s in branches:
+        out = jax.tree.map(lambda a, b: jnp.where(g, b, a), out, s)
+    return out
+
+
+# -- action families ---------------------------------------------------------
+
+def k_restart(bounds, s, i):
+    """``Restart(i)`` (raft.tla:167-175): always enabled."""
+    out = dict(s)
+    out["role"] = _set1(s["role"], i, SP.FOLLOWER)
+    out["vResp"] = _set1(s["vResp"], i, 0)
+    out["vGrant"] = _set1(s["vGrant"], i, 0)
+    out["nextIndex"] = _set_row(s["nextIndex"], i, 1)
+    out["matchIndex"] = _set_row(s["matchIndex"], i, 0)
+    out["commitIndex"] = _set1(s["commitIndex"], i, 0)
+    return out, jnp.bool_(True), jnp.bool_(False)
+
+
+def k_timeout(bounds, s, i):
+    """``Timeout(i)`` (raft.tla:178-187)."""
+    valid = (s["role"][i] == SP.FOLLOWER) | (s["role"][i] == SP.CANDIDATE)
+    out = dict(s)
+    out["role"] = _set1(s["role"], i, SP.CANDIDATE)
+    out["term"] = _set1(s["term"], i, s["term"][i] + 1)
+    out["votedFor"] = _set1(s["votedFor"], i, SP.NIL)
+    out["vResp"] = _set1(s["vResp"], i, 0)
+    out["vGrant"] = _set1(s["vGrant"], i, 0)
+    return out, valid, jnp.bool_(False)
+
+
+def k_request_vote(bounds, s, i, j):
+    """``RequestVote(i, j)`` (raft.tla:190-199); j may equal i."""
+    valid = (s["role"][i] == SP.CANDIDATE) & (((s["vResp"][i] >> j) & 1) == 0)
+    hi, lo = mb.rv_request(s["term"][i], _last_term(s, i), s["logLen"][i], i, j)
+    out, ovf = bag_add(s, hi, lo)
+    return out, valid, valid & ovf
+
+
+def k_append_entries(bounds, s, i, j):
+    """``AppendEntries(i, j)`` (raft.tla:204-226): <=1 entry, heartbeats incl."""
+    Lcap = s["logTerm"].shape[1]
+    valid = (i != j) & (s["role"][i] == SP.LEADER)
+    ni = s["nextIndex"][i, j]
+    prev_idx = ni - 1
+    prev_term = jnp.where(
+        prev_idx > 0, s["logTerm"][i, jnp.clip(prev_idx - 1, 0, Lcap - 1)], 0)
+    last_entry = jnp.minimum(s["logLen"][i], ni)        # raft.tla:213
+    has_ent = ni <= last_entry
+    eidx = jnp.clip(ni - 1, 0, Lcap - 1)
+    ent_term = jnp.where(has_ent, s["logTerm"][i, eidx], 0)
+    ent_val = jnp.where(has_ent, s["logVal"][i, eidx], 0)
+    hi, lo = mb.ae_request(
+        s["term"][i], prev_idx, prev_term, has_ent.astype(I32), ent_term,
+        ent_val, jnp.minimum(s["commitIndex"][i], last_entry), i, j)
+    out, ovf = bag_add(s, hi, lo)
+    return out, valid, valid & ovf
+
+
+def k_become_leader(bounds, s, i):
+    """``BecomeLeader(i)`` (raft.tla:229-243); Quorum as popcount."""
+    n = bounds.n_servers
+    valid = ((s["role"][i] == SP.CANDIDATE)
+             & (2 * _popcount(s["vGrant"][i]) > n))
+    out = dict(s)
+    out["role"] = _set1(s["role"], i, SP.LEADER)
+    out["nextIndex"] = _set_row(s["nextIndex"], i, s["logLen"][i] + 1)
+    out["matchIndex"] = _set_row(s["matchIndex"], i, 0)
+    return out, valid, jnp.bool_(False)
+
+
+def k_client_request(bounds, s, i, v):
+    """``ClientRequest(i, v)`` (raft.tla:246-253)."""
+    Lcap = s["logTerm"].shape[1]
+    ln = s["logLen"][i]
+    valid = s["role"][i] == SP.LEADER
+    row = _onehot(i, bounds.n_servers)[:, None]
+    col = (jnp.arange(Lcap) == ln)[None, :]
+    out = dict(s)
+    out["logTerm"] = jnp.where(row & col, s["term"][i], s["logTerm"]).astype(I32)
+    out["logVal"] = jnp.where(row & col, v, s["logVal"]).astype(I32)
+    out["logLen"] = _set1(s["logLen"], i, ln + 1)
+    # ln == Lcap would silently drop the entry; the capacity scheme makes it
+    # unreachable from constraint-satisfying states — flag, don't clamp.
+    return out, valid, valid & (ln >= Lcap)
+
+
+def k_advance_commit(bounds, s, i):
+    """``AdvanceCommitIndex(i)`` (raft.tla:259-276).
+
+    ``Agree(index) == {i} \\cup {k : matchIndex[i][k] >= index}``; commits
+    ``Max(agreeIndexes)`` only if that entry's term is current
+    (raft.tla:268-270).
+    """
+    n, Lcap = bounds.n_servers, s["logTerm"].shape[1]
+    valid = s["role"][i] == SP.LEADER
+    idxs = jnp.arange(1, Lcap + 1)                                   # [L]
+    others = s["matchIndex"][i][None, :] >= idxs[:, None]            # [L, n]
+    in_set = others | (jnp.arange(n)[None, :] == i)                  # {i} ∪ ...
+    agree_cnt = jnp.sum(in_set.astype(I32), axis=1)
+    agree_ok = (2 * agree_cnt > n) & (idxs <= s["logLen"][i])
+    max_agree = jnp.max(jnp.where(agree_ok, idxs, 0))
+    t_at = s["logTerm"][i, jnp.clip(max_agree - 1, 0, Lcap - 1)]
+    commit = jnp.where((max_agree > 0) & (t_at == s["term"][i]),
+                       max_agree, s["commitIndex"][i])
+    out = dict(s)
+    out["commitIndex"] = _set1(s["commitIndex"], i, commit)
+    return out, valid, jnp.bool_(False)
+
+
+# -- Receive(m): deterministic dispatch over one slot (raft.tla:421-436) -----
+
+def k_receive(bounds, s, slot):
+    n, Lcap = bounds.n_servers, s["logTerm"].shape[1]
+    occupied = s["msgCount"][slot] > 0
+    hi, lo = s["msgHi"][slot], s["msgLo"][slot]
+    i, j = mb.dst(hi), mb.src(hi)
+    mt, mty = mb.mterm(hi), mb.mtype(hi)
+    ct = s["term"][i]
+    role_i = s["role"][i]
+    len_i = s["logLen"][i]
+    ovf = jnp.bool_(False)
+
+    # UpdateTerm (raft.tla:406-412): any type, message kept.
+    g_upd = mt > ct
+    s_upd = dict(s)
+    s_upd["term"] = _set1(s["term"], i, mt)
+    s_upd["role"] = _set1(s["role"], i, SP.FOLLOWER)
+    s_upd["votedFor"] = _set1(s["votedFor"], i, SP.NIL)
+
+    not_upd = ~g_upd  # below here mterm <= currentTerm[i]
+
+    # HandleRequestVoteRequest (raft.tla:284-303)
+    g_rvreq = not_upd & (mty == SP.M_RVREQ)
+    log_ok_rv = ((mb.fa(hi) > _last_term(s, i))
+                 | ((mb.fa(hi) == _last_term(s, i))
+                    & (mb.fb(hi) >= len_i)))                  # raft.tla:285-287
+    grant = ((mt == ct) & log_ok_rv
+             & ((s["votedFor"][i] == SP.NIL)
+                | (s["votedFor"][i] == j + 1)))               # raft.tla:288-290
+    resp_hi, resp_lo = mb.rv_response(ct, grant.astype(I32), i, j)
+    s_rvreq = dict(s)
+    s_rvreq["votedFor"] = jnp.where(
+        grant, _set1(s["votedFor"], i, j + 1), s["votedFor"])  # raft.tla:292
+    s_rvreq, ovf_rv = reply(s_rvreq, resp_hi, resp_lo, hi, lo)
+    ovf |= g_rvreq & ovf_rv
+
+    # RequestVoteResponse: DropStaleResponse | HandleRequestVoteResponse
+    g_rvresp_drop = not_upd & (mty == SP.M_RVRESP) & (mt < ct)   # raft.tla:415-418
+    g_rvresp = not_upd & (mty == SP.M_RVRESP) & (mt == ct)       # raft.tla:307-321
+    s_drop = bag_remove(s, hi, lo)
+    s_rvresp = dict(s)
+    s_rvresp["vResp"] = _set1(s["vResp"], i, s["vResp"][i] | (1 << j))
+    s_rvresp["vGrant"] = jnp.where(
+        mb.fa(hi) > 0,
+        _set1(s["vGrant"], i, s["vGrant"][i] | (1 << j)), s["vGrant"])
+    s_rvresp = bag_remove(s_rvresp, hi, lo)
+
+    # HandleAppendEntriesRequest (raft.tla:327-389)
+    prev_idx, prev_term = mb.fa(hi), mb.fb(hi)
+    n_ent, ent_term, ent_val = mb.fc(lo), mb.fd(lo), mb.fe(lo)
+    log_ok_ae = ((prev_idx == 0)
+                 | ((prev_idx > 0) & (prev_idx <= len_i)
+                    & (prev_term == s["logTerm"][
+                        i, jnp.clip(prev_idx - 1, 0, Lcap - 1)])))  # :328-331
+    is_ae = not_upd & (mty == SP.M_AEREQ)
+    g_ae_reject = is_ae & ((mt < ct)
+                           | ((mt == ct) & (role_i == SP.FOLLOWER)
+                              & ~log_ok_ae))                        # :333-337
+    rej_hi, rej_lo = mb.ae_response(ct, 0, 0, i, j)                 # :338-344
+    s_ae_reject, ovf_rej = reply(s, rej_hi, rej_lo, hi, lo)
+    ovf |= g_ae_reject & ovf_rej
+
+    g_ae_step = is_ae & (mt == ct) & (role_i == SP.CANDIDATE)       # :346-350
+    s_ae_step = dict(s)
+    s_ae_step["role"] = _set1(s["role"], i, SP.FOLLOWER)            # msg kept
+
+    accept = is_ae & (mt == ct) & (role_i == SP.FOLLOWER) & log_ok_ae
+    index = prev_idx + 1
+    t_at_index = s["logTerm"][i, jnp.clip(index - 1, 0, Lcap - 1)]
+    g_ae_done = accept & ((n_ent == 0)
+                          | ((len_i >= index) & (t_at_index == ent_term)))
+    # already done (raft.tla:356-374): commitIndex := mcommitIndex (may
+    # decrease, :361-363), Reply success.
+    done_hi, done_lo = mb.ae_response(ct, 1, prev_idx + n_ent, i, j)
+    s_ae_done = dict(s)
+    s_ae_done["commitIndex"] = _set1(s["commitIndex"], i, mb.ff(lo))
+    s_ae_done, ovf_done = reply(s_ae_done, done_hi, done_lo, hi, lo)
+    ovf |= g_ae_done & ovf_done
+
+    g_ae_conflict = accept & (n_ent > 0) & (len_i >= index) \
+        & (t_at_index != ent_term)                                  # :375-382
+    # conflict: drop exactly one entry off the TAIL; message kept.
+    row = _onehot(i, n)[:, None]
+    tail = (jnp.arange(Lcap) == (len_i - 1))[None, :]
+    s_ae_conflict = dict(s)
+    s_ae_conflict["logTerm"] = jnp.where(row & tail, 0, s["logTerm"]).astype(I32)
+    s_ae_conflict["logVal"] = jnp.where(row & tail, 0, s["logVal"]).astype(I32)
+    s_ae_conflict["logLen"] = _set1(s["logLen"], i, len_i - 1)
+
+    g_ae_append = accept & (n_ent > 0) & (len_i == prev_idx)        # :383-388
+    newcol = (jnp.arange(Lcap) == len_i)[None, :]
+    s_ae_append = dict(s)
+    s_ae_append["logTerm"] = jnp.where(row & newcol, ent_term,
+                                       s["logTerm"]).astype(I32)
+    s_ae_append["logVal"] = jnp.where(row & newcol, ent_val,
+                                      s["logVal"]).astype(I32)
+    s_ae_append["logLen"] = _set1(s["logLen"], i, len_i + 1)
+    ovf |= g_ae_append & (len_i >= Lcap)
+
+    # AppendEntriesResponse: DropStaleResponse | Handle (raft.tla:393-403)
+    g_aeresp_drop = not_upd & (mty == SP.M_AERESP) & (mt < ct)
+    g_aeresp = not_upd & (mty == SP.M_AERESP) & (mt == ct)
+    succ_flag = mb.fa(hi) > 0
+    match = mb.fb(hi)
+    ni_new = jnp.where(succ_flag, match + 1,
+                       jnp.maximum(s["nextIndex"][i, j] - 1, 1))
+    s_aeresp = dict(s)
+    s_aeresp["nextIndex"] = _set2(s["nextIndex"], i, j, ni_new)
+    s_aeresp["matchIndex"] = jnp.where(
+        succ_flag, _set2(s["matchIndex"], i, j, match), s["matchIndex"])
+    s_aeresp = bag_remove(s_aeresp, hi, lo)
+
+    branches = [
+        (g_upd, s_upd),
+        (g_rvreq, s_rvreq),
+        (g_rvresp_drop, s_drop),
+        (g_rvresp, s_rvresp),
+        (g_ae_reject, s_ae_reject),
+        (g_ae_step, s_ae_step),
+        (g_ae_done, s_ae_done),
+        (g_ae_conflict, s_ae_conflict),
+        (g_ae_append, s_ae_append),
+        (g_aeresp_drop, s_drop),
+        (g_aeresp, s_aeresp),
+    ]
+    any_branch = functools.reduce(jnp.logical_or, (g for g, _ in branches))
+    out = _tree_select(branches, s)
+    valid = occupied & any_branch
+    return out, valid, valid & ovf
+
+
+def k_duplicate(bounds, s, slot):
+    """``DuplicateMessage(m)`` (raft.tla:443-445)."""
+    occupied = s["msgCount"][slot] > 0
+    out = dict(s)
+    out["msgCount"] = (s["msgCount"]
+                       + (jnp.arange(s["msgCount"].shape[0]) == slot)
+                       .astype(I32))
+    return out, occupied, jnp.bool_(False)
+
+
+def k_drop(bounds, s, slot):
+    """``DropMessage(m)`` (raft.tla:448-450)."""
+    occupied = s["msgCount"][slot] > 0
+    out = bag_remove(s, s["msgHi"][slot], s["msgLo"][slot])
+    return out, occupied, jnp.bool_(False)
+
+
+# -- assembly ----------------------------------------------------------------
+
+_FAMILY_KERNELS = {
+    SP.RESTART: (k_restart, ("i",)),
+    SP.TIMEOUT: (k_timeout, ("i",)),
+    SP.REQUESTVOTE: (k_request_vote, ("i", "j")),
+    SP.BECOMELEADER: (k_become_leader, ("i",)),
+    SP.CLIENTREQUEST: (k_client_request, ("i", "v")),
+    SP.ADVANCECOMMIT: (k_advance_commit, ("i",)),
+    SP.APPENDENTRIES: (k_append_entries, ("i", "j")),
+    SP.RECEIVE: (k_receive, ("slot",)),
+    SP.DUPLICATE: (k_duplicate, ("slot",)),
+    SP.DROP: (k_drop, ("slot",)),
+}
+
+
+def build_expand(bounds: Bounds, spec: str = "full"):
+    """Build ``expand(struct) -> (succs[A,...], valid[A], overflow[A])``.
+
+    The A successor lanes follow models/spec.action_table order exactly;
+    every successor is canonicalized (message slots sorted).  Pure function
+    of a single state struct — vmap/jit at the call site.
+    """
+    table = SP.action_table(bounds, spec)
+    # Group contiguous instances of the same family for vectorized dispatch.
+    groups: list[tuple[str, list[SP.ActionInstance]]] = []
+    for a in table:
+        if groups and groups[-1][0] == a.family:
+            groups[-1][1].append(a)
+        else:
+            groups.append((a.family, [a]))
+
+    def expand(s):
+        succs, valids, ovfs = [], [], []
+        for fam, instances in groups:
+            kern, params = _FAMILY_KERNELS[fam]
+            args = [jnp.asarray([getattr(a, p) for a in instances], dtype=I32)
+                    for p in params]
+            fn = functools.partial(kern, bounds)
+            batched = jax.vmap(fn, in_axes=(None,) + (0,) * len(args))
+            out, valid, ovf = batched(s, *args)
+            succs.append(out)
+            valids.append(jnp.broadcast_to(valid, (len(instances),)))
+            ovfs.append(jnp.broadcast_to(ovf, (len(instances),)))
+        all_succs = jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *succs)
+        all_succs = jax.vmap(lambda t: st.canonicalize(t, jnp))(all_succs)
+        return all_succs, jnp.concatenate(valids), jnp.concatenate(ovfs)
+
+    return expand
+
+
+def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = ()):
+    """One fused frontier step: packed vecs -> everything the engine needs.
+
+    ``step(vecs[B, W]) -> dict`` with packed successors ``svecs [B, A, W]``,
+    ``valid``/``overflow`` ``[B, A]``, fingerprint lanes ``fp_hi/fp_lo``
+    ``[B, A]`` (uint32), per-invariant truth ``inv_ok [B, A, n_inv]``, and
+    StateConstraint satisfaction ``con_ok [B, A]``.  Everything downstream of
+    the expansion fuses into one XLA computation — one device round-trip per
+    frontier chunk.
+    """
+    from raft_tla_tpu.models import invariants as inv_mod
+
+    lay = st.Layout.of(bounds)
+    consts = jnp.asarray(fpr.lane_constants(lay.width))
+    expand = build_expand(bounds, spec)
+    inv_fns = [inv_mod.jnp_invariant(nm, bounds) for nm in invariants]
+
+    def step(vecs):
+        structs = jax.vmap(lambda v: st.unpack(v, lay, jnp))(vecs)
+        succs, valid, ovf = jax.vmap(expand)(structs)
+        svecs = jax.vmap(jax.vmap(lambda t: st.pack(t, jnp)))(succs)
+        fp_hi, fp_lo = fpr.fingerprint(svecs, consts, jnp)
+        if inv_fns:
+            inv_ok = jnp.stack(
+                [jax.vmap(jax.vmap(f))(succs) for f in inv_fns], axis=-1)
+        else:
+            inv_ok = jnp.ones(valid.shape + (0,), dtype=bool)
+        con_ok = jax.vmap(jax.vmap(
+            lambda t: st.constraint_ok(t, bounds, jnp)))(succs)
+        return {"svecs": svecs, "valid": valid, "overflow": ovf,
+                "fp_hi": fp_hi, "fp_lo": fp_lo, "inv_ok": inv_ok,
+                "con_ok": con_ok}
+
+    return step

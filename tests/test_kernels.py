@@ -1,0 +1,125 @@
+"""Kernel/interpreter differential — SURVEY §4.2.
+
+Every successor lane of the batched JAX kernel must agree with the reference
+interpreter: same enabledness, same canonical successor state, on (a) random
+bounded states (including unreachable corners like same-term leaders) and
+(b) exact reachable prefixes from Init.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.models import interp, spec as SP
+from raft_tla_tpu.ops import kernels, state as st
+
+from test_state import random_pystate
+
+B3 = Bounds(n_servers=3, n_values=2, max_term=3, max_log=2, max_msgs=4)
+
+
+def _diff_on_states(states, bounds, spec="full"):
+    table = SP.action_table(bounds, spec)
+    expand = jax.jit(jax.vmap(kernels.build_expand(bounds, spec)))
+    structs = [interp.to_struct(s, bounds) for s in states]
+    batch = jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs)), *structs)
+    succs, valid, ovf = expand(batch)
+    succs = jax.tree.map(np.asarray, succs)
+    valid = np.asarray(valid)
+    ovf = np.asarray(ovf)
+
+    for bi, s in enumerate(states):
+        # The +1 capacity scheme guarantees representability only one step
+        # past the constraint: overflow must never fire on states the engine
+        # would actually expand (constraint-satisfying ones).
+        if interp.constraint_ok(s, bounds):
+            assert not ovf[bi].any(), f"overflow on expandable state {s}"
+        got_by_lane = {}
+        for ai in range(len(table)):
+            if valid[bi, ai] and not ovf[bi, ai]:
+                lane = jax.tree.map(lambda x: x[bi, ai], succs)
+                got_by_lane[ai] = interp.from_struct(lane, bounds)
+        want_by_lane = dict(interp.successors(s, bounds, table))
+        for ai in range(len(table)):
+            if valid[bi, ai] and ovf[bi, ai]:
+                # Lane flagged unrepresentable: the interpreter successor must
+                # genuinely exceed tensor capacity (bag slots).
+                t = want_by_lane.pop(ai)
+                assert len(t.msgs) > bounds.msg_cap or \
+                    any(len(l) > bounds.log_cap for l in t.log)
+        assert set(got_by_lane) == set(want_by_lane), (
+            f"state {bi}: enabled lanes differ\n"
+            f"kernel-only: {[table[a].label() for a in set(got_by_lane) - set(want_by_lane)]}\n"
+            f"interp-only: {[table[a].label() for a in set(want_by_lane) - set(got_by_lane)]}\n"
+            f"state: {s}")
+        for ai, got in got_by_lane.items():
+            assert got == want_by_lane[ai], (
+                f"state {bi} lane {table[ai].label()}:\n"
+                f"kernel: {got}\ninterp: {want_by_lane[ai]}\nfrom:   {s}")
+
+
+def test_differential_random_states():
+    rng = np.random.default_rng(7)
+    states = [random_pystate(rng, B3) for _ in range(200)]
+    _diff_on_states(states, B3)
+
+
+def test_differential_reachable_prefix():
+    bounds = Bounds(n_servers=3, n_values=1, max_term=2, max_log=1,
+                    max_msgs=2)
+    seen = {interp.init_state(bounds)}
+    frontier = list(seen)
+    for _level in range(4):
+        nxt = []
+        for s in frontier:
+            if not interp.constraint_ok(s, bounds):
+                continue
+            for _a, t in interp.successors(s, bounds):
+                if t not in seen:
+                    seen.add(t)
+                    nxt.append(t)
+        frontier = nxt
+    states = sorted(seen, key=lambda s: interp.to_vec(s, bounds).tobytes())
+    _diff_on_states(states[:400], bounds)
+
+
+def test_differential_election_spec():
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=3)
+    rng = np.random.default_rng(11)
+    states = [random_pystate(rng, bounds) for _ in range(100)]
+    _diff_on_states(states, bounds, spec="election")
+
+
+def test_step_outputs_consistent():
+    """build_step: fingerprints/invariants/constraints agree with host."""
+    from raft_tla_tpu.ops import fingerprint as fpr
+    from raft_tla_tpu.models import invariants as inv_mod
+
+    bounds = B3
+    lay = st.Layout.of(bounds)
+    rng = np.random.default_rng(13)
+    states = [random_pystate(rng, bounds) for _ in range(32)]
+    vecs = np.stack([interp.to_vec(s, bounds) for s in states])
+    step = jax.jit(kernels.build_step(bounds, "full",
+                                      ("NoTwoLeaders", "LogMatching")))
+    out = {k: np.asarray(v) for k, v in step(jnp.asarray(vecs)).items()}
+
+    consts = fpr.lane_constants(lay.width)
+    h1, h2 = fpr.fingerprint(out["svecs"], consts, np)
+    np.testing.assert_array_equal(h1, out["fp_hi"])
+    np.testing.assert_array_equal(h2, out["fp_lo"])
+
+    es = inv_mod.py_invariant("NoTwoLeaders")
+    lm = inv_mod.py_invariant("LogMatching")
+    for bi in range(len(states)):
+        for ai in range(out["valid"].shape[1]):
+            if not out["valid"][bi, ai] or out["overflow"][bi, ai]:
+                continue
+            t = interp.from_struct(
+                st.unpack(out["svecs"][bi, ai], lay, np), bounds)
+            assert out["inv_ok"][bi, ai, 0] == es(t, bounds)
+            assert out["inv_ok"][bi, ai, 1] == lm(t, bounds)
+            assert out["con_ok"][bi, ai] == interp.constraint_ok(t, bounds)

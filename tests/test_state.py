@@ -50,8 +50,11 @@ def random_pystate(rng, bounds: Bounds) -> interp.PyState:
         log=tuple(logs),
         vResp=tuple(int(x) for x in rng.integers(0, 2**n, n)),
         vGrant=tuple(int(x) for x in rng.integers(0, 2**n, n)),
-        nextIndex=tuple(tuple(int(x) for x in rng.integers(1, bounds.log_cap + 2, n))
-                        for _ in range(n)),
+        # nextIndex[i][j] <= Len(log[i]) + 1: beyond that, AppendEntries'
+        # log[i][prevLogIndex] (raft.tla:209) is an undefined partial-function
+        # application (TLC would error); reachable states always satisfy it.
+        nextIndex=tuple(tuple(int(x) for x in rng.integers(1, len(logs[i]) + 2, n))
+                        for i in range(n)),
         matchIndex=tuple(tuple(int(x) for x in rng.integers(0, bounds.log_cap + 1, n))
                          for _ in range(n)),
         msgs=tuple(sorted(msgs.items())),
