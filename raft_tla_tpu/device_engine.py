@@ -1,0 +1,356 @@
+"""Device-resident BFS engine — the flagship L4 checker (SURVEY §7.1 step 5-6).
+
+``engine.py`` proved the semantics with a host-side dedup loop; this module is
+the TPU-first redesign the hardware demands.  Measured on the deployment
+tunnel, every host↔device round trip costs ~0.7 s and every eager-op compile
+~10 s, so the only architecture that can hit the <60 s north star is one where
+**the entire breadth-first search is a single jitted computation**: the state
+store, the fingerprint table, the frontier, parent links, coverage counters
+and violation flags all live in HBM, and one ``jax.jit`` call runs the whole
+exploration with ``lax.while_loop`` over levels and chunks.  The host sees
+nothing until the search ends (stats + flags), then makes at most two more
+gathers to reconstruct a counterexample trace.
+
+Architecture (all shapes static — XLA's compilation model, SURVEY §7.2.4):
+
+- **Store** ``[Ncap, W] int32``: every discovered state, in discovery order.
+  Because BFS is level-synchronous, each level is a *contiguous segment*
+  ``[level_start, level_end)`` — the frontier is a slice of the store, never
+  a separate buffer.
+- **Fingerprint table** ``2·[Tcap] uint32``: open-addressing, linear-probe
+  hash set of (hi, lo) fingerprint pairs (TLC's FP64 set, SURVEY §2.8).
+  Batched insert uses a claim protocol built on XLA ``scatter-min``: all
+  candidates probe in lockstep; contenders for an empty slot scatter-min
+  their flat index; winners insert, equal-key losers resolve as duplicates,
+  others advance their probe.  ``scatter-min`` by flat index also makes the
+  *first* candidate in discovery order the winner — exactly the oracle's
+  first-discoverer-is-parent rule, so parent links and traces match refbfs.
+- **Per-chunk fused step** (``ops/kernels.build_step``): unpack → all action
+  guards/effects → canonicalize → pack → fingerprint → invariants →
+  constraint, for ``chunk`` states × A action lanes at a time.
+- **TLC CONSTRAINT semantics**: states violating the bound are stored,
+  counted and invariant-checked, but their expansion lanes are masked off
+  (``conflag`` gates ``valid``).
+- **Failure is loud** (SURVEY §4.5): store overflow, level overflow, probe
+  overflow and transition-capacity overflow each set a flag that aborts the
+  search; the host raises.  Nothing is silently clamped.
+
+Fingerprint collisions merge states, as in TLC (probability ~2^-64 per pair;
+the parity tests run on spaces where a collision would surface as a count
+mismatch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from collections import Counter
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import CheckConfig
+from raft_tla_tpu.engine import EngineResult, Violation
+from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
+from raft_tla_tpu.ops import fingerprint as fpr
+from raft_tla_tpu.ops import kernels
+from raft_tla_tpu.ops import state as st
+
+I32 = jnp.int32
+U32 = jnp.uint32
+_EMPTY = np.uint32(0xFFFFFFFF)   # table sentinel: both words all-ones
+_MAX_PROBE = 64                  # linear-probe safety cap -> fail flag
+
+
+@dataclasses.dataclass(frozen=True)
+class Capacities:
+    """Static shapes of one compiled search. Doubling any field recompiles."""
+
+    n_states: int = 1 << 20      # store rows (Ncap)
+    levels: int = 256            # max BFS depth (Lcap)
+
+    @property
+    def table(self) -> int:      # hash slots, load factor <= 0.5
+        return 1 << (2 * self.n_states - 1).bit_length()
+
+    def grown(self) -> "Capacities":
+        return dataclasses.replace(self, n_states=self.n_states * 2)
+
+
+def _dedup_insert(tbl_hi, tbl_lo, key_hi, key_lo, active):
+    """Batched insert-if-absent of fingerprint pairs into the hash set.
+
+    Returns ``(tbl_hi, tbl_lo, is_new, probe_fail)``.  ``is_new[c]`` is True
+    iff candidate c's key was absent and c is the *first* active candidate
+    (smallest flat index) carrying that key in this batch.
+    """
+    BA = key_hi.shape[0]
+    T = tbl_hi.shape[0]
+    mask = jnp.uint32(T - 1)
+    ids = jnp.arange(BA, dtype=I32)
+    h0 = key_lo & mask           # lo lane is already avalanche-mixed
+
+    def cond(c):
+        _, _, unres, _, d, _ = c
+        return jnp.any(unres) & (d < _MAX_PROBE)
+
+    def body(c):
+        tbl_hi, tbl_lo, unres, is_new, d, dist = c
+        idx = ((h0 + dist.astype(U32)) & mask).astype(I32)
+        cur_hi, cur_lo = tbl_hi[idx], tbl_lo[idx]
+        empty = (cur_hi == _EMPTY) & (cur_lo == _EMPTY)
+        match = (cur_hi == key_hi) & (cur_lo == key_lo)
+        dup_old = unres & match & ~empty
+        contend = unres & empty
+        claim = jnp.full((T,), BA, dtype=I32).at[
+            jnp.where(contend, idx, T)].min(
+                jnp.where(contend, ids, BA), mode="drop")
+        won = contend & (claim[idx] == ids)
+        sl = jnp.where(won, idx, T)
+        tbl_hi = tbl_hi.at[sl].set(key_hi, mode="drop")
+        tbl_lo = tbl_lo.at[sl].set(key_lo, mode="drop")
+        # losers re-read: did the winner carry my key?
+        dup_batch = contend & ~won & (tbl_hi[idx] == key_hi) & \
+            (tbl_lo[idx] == key_lo)
+        resolved = dup_old | won | dup_batch
+        unres = unres & ~resolved
+        dist = dist + unres.astype(I32)
+        return tbl_hi, tbl_lo, unres, is_new | won, d + 1, dist
+
+    init = (tbl_hi, tbl_lo, active, jnp.zeros((BA,), bool), jnp.int32(0),
+            jnp.zeros((BA,), I32))
+    tbl_hi, tbl_lo, unres, is_new, _, _ = jax.lax.while_loop(cond, body, init)
+    return tbl_hi, tbl_lo, is_new, jnp.any(unres)
+
+
+def _build_search(config: CheckConfig, caps: Capacities, A: int, W: int):
+    """Trace the full search as one jittable function of the initial state."""
+    B = config.chunk
+    n_inv = len(config.invariants)
+    step = kernels.build_step(config.bounds, config.spec,
+                              tuple(config.invariants))
+    Ncap, Lcap, Tcap = caps.n_states, caps.levels, caps.table
+    BIG = jnp.int32(np.iinfo(np.int32).max)
+
+    def chunk_body(carry, c):
+        (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail) = carry
+        start = lvl_start + c * B
+        gstart = jnp.minimum(start, Ncap - B)      # clamped window (see below)
+        rows_g = gstart + jnp.arange(B, dtype=I32)
+        row_act = (rows_g >= start) & (rows_g < lvl_end)
+        vecs = jax.lax.dynamic_slice(store, (gstart, 0), (B, W))
+        out = step(vecs)
+        con_par = jax.lax.dynamic_slice(conflag, (gstart,), (B,))
+        valid = out["valid"] & row_act[:, None] & con_par[:, None]
+        n_trans = n_trans + jnp.sum(valid.astype(I32))
+        fail = fail | jnp.any(valid & out["overflow"])        # capacity bug
+
+        fhi = out["fp_hi"].reshape(-1)
+        flo = out["fp_lo"].reshape(-1)
+        fvalid = valid.reshape(-1)
+        tbl_hi, tbl_lo, is_new, pfail = _dedup_insert(
+            tbl_hi, tbl_lo, fhi, flo, fvalid)
+        fail = fail | pfail
+
+        # Append new states to the store in discovery order.
+        pos = n_states + jnp.cumsum(is_new.astype(I32)) - 1
+        sl = jnp.where(is_new & (pos < Ncap), pos, Ncap)
+        svecs = out["svecs"].reshape(B * A, W)
+        store = store.at[sl].set(svecs, mode="drop")
+        flat_b = jnp.arange(B * A, dtype=I32) // A
+        flat_a = jnp.arange(B * A, dtype=I32) % A
+        parent = parent.at[sl].set(gstart + flat_b, mode="drop")
+        lane = lane.at[sl].set(flat_a, mode="drop")
+        conflag = conflag.at[sl].set(out["con_ok"].reshape(-1), mode="drop")
+        cov = cov.at[jnp.where(is_new, flat_a, A)].add(1, mode="drop")
+
+        n_new = jnp.sum(is_new.astype(I32))
+        fail = fail | (n_states + n_new > Ncap)               # store overflow
+        n_states = jnp.minimum(n_states + n_new, Ncap)
+
+        # First invariant violation among new states, in discovery order.
+        inv_bad = is_new & jnp.any(
+            ~out["inv_ok"].reshape(B * A, n_inv), axis=-1) if n_inv \
+            else jnp.zeros((B * A,), bool)
+        first = jnp.min(jnp.where(inv_bad, jnp.arange(B * A, dtype=I32), BIG))
+        has_viol = first < BIG
+        new_viol = has_viol & (viol_g < 0)
+        viol_g = jnp.where(new_viol, pos[jnp.minimum(first, B * A - 1)],
+                           viol_g)
+        bad_inv = jnp.argmax(
+            ~out["inv_ok"].reshape(B * A, n_inv)
+            [jnp.minimum(first, B * A - 1)]) if n_inv else jnp.int32(0)
+        viol_i = jnp.where(new_viol, bad_inv, viol_i)
+        return (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+                lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail)
+
+    def level_body(carry):
+        (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
+         levels, lvl) = carry
+        n_act = lvl_end - lvl_start
+        n_chunks = (n_act + B - 1) // B
+
+        def ccond(c_carry):
+            c, inner = c_carry
+            return (c < n_chunks) & (inner[9] < 0) & ~inner[13]
+
+        def cbody(c_carry):
+            c, inner = c_carry
+            return c + 1, chunk_body(inner, c)
+
+        inner = (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+                 lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail)
+        _, inner = jax.lax.while_loop(ccond, cbody, (jnp.int32(0), inner))
+        (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail) = inner
+        n_new = n_states - lvl_end
+        levels = levels.at[jnp.minimum(lvl, Lcap - 1)].set(n_new)
+        fail = fail | (lvl >= Lcap - 1) & (n_new > 0)
+        return (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+                lvl_end, n_states, viol_g, viol_i, n_trans, cov, fail,
+                levels, lvl + 1)
+
+    def level_cond(carry):
+        (_s, _p, _l, _c, _th, _tl, _n, lvl_start, lvl_end, viol_g, _vi,
+         _nt, _cov, fail, _levels, _lvl) = carry
+        return (lvl_end > lvl_start) & (viol_g < 0) & ~fail
+
+    def search(init_vec, init_key_hi, init_key_lo, init_con):
+        store = jnp.zeros((Ncap, W), I32).at[0].set(init_vec)
+        parent = jnp.full((Ncap,), -1, I32)
+        lane = jnp.full((Ncap,), -1, I32)
+        conflag = jnp.zeros((Ncap,), bool).at[0].set(init_con)
+        tbl_hi = jnp.full((Tcap,), _EMPTY, U32).at[
+            (init_key_lo & jnp.uint32(Tcap - 1)).astype(I32)].set(init_key_hi)
+        tbl_lo = jnp.full((Tcap,), _EMPTY, U32).at[
+            (init_key_lo & jnp.uint32(Tcap - 1)).astype(I32)].set(init_key_lo)
+        levels = jnp.zeros((Lcap,), I32)
+        carry = (store, parent, lane, conflag, tbl_hi, tbl_lo,
+                 jnp.int32(1), jnp.int32(0), jnp.int32(1),
+                 jnp.int32(-1), jnp.int32(0), jnp.int32(0),
+                 jnp.zeros((A,), I32), jnp.bool_(False),
+                 levels, jnp.int32(1))
+        carry = jax.lax.while_loop(level_cond, level_body, carry)
+        (store, parent, lane, conflag, _th, _tl, n_states, _ls, _le,
+         viol_g, viol_i, n_trans, cov, fail, levels, lvl) = carry
+        return {"store": store, "parent": parent, "lane": lane,
+                "n_states": n_states, "viol_g": viol_g, "viol_i": viol_i,
+                "n_transitions": n_trans, "coverage": cov, "fail": fail,
+                "levels": levels, "n_levels": lvl}
+
+    return search
+
+
+class DeviceEngine:
+    """One compiled exhaustive checker; reusable across runs."""
+
+    def __init__(self, config: CheckConfig, caps: Capacities | None = None,
+                 device=None):
+        self.config = config
+        self.bounds = config.bounds
+        self.lay = st.Layout.of(self.bounds)
+        self.table = S.action_table(self.bounds, config.spec)
+        self.A = len(self.table)
+        self.caps = caps or Capacities()
+        if self.caps.n_states < config.chunk:
+            raise ValueError("Capacities.n_states must be >= config.chunk")
+        # jit follows input placement; ``device`` (None = default backend)
+        # is applied to the four small inputs in check().
+        self.device = device
+        self._search = jax.jit(
+            _build_search(config, self.caps, self.A, self.lay.width))
+
+    def check(self, init_override: interp.PyState | None = None
+              ) -> EngineResult:
+        t0 = time.monotonic()
+        bounds = self.bounds
+        init_py = init_override if init_override is not None \
+            else interp.init_state(bounds)
+        init_vec = interp.to_vec(init_py, bounds)
+        consts = fpr.lane_constants(self.lay.width)
+        hi0, lo0 = fpr.fingerprint(init_vec.astype(np.int32), consts, np)
+
+        for nm in self.config.invariants:
+            if not inv_mod.py_invariant(nm)(init_py, bounds):
+                return EngineResult(
+                    n_states=1, diameter=0, n_transitions=0,
+                    coverage=Counter(),
+                    violation=Violation(nm, init_py, [(None, init_py)]),
+                    levels=[1], wall_s=time.monotonic() - t0)
+
+        args = (jnp.asarray(init_vec, I32), jnp.uint32(hi0), jnp.uint32(lo0),
+                jnp.bool_(interp.constraint_ok(init_py, bounds)))
+        if self.device is not None:
+            args = jax.device_put(args, self.device)
+        out = self._search(*args)
+        # One blocking transfer for the scalars/small arrays.
+        n_states = int(out["n_states"])
+        fail = bool(out["fail"])
+        if fail:
+            raise RuntimeError(
+                "device search aborted: store/level/probe capacity exceeded "
+                f"(caps={self.caps}) or state-width overflow — grow "
+                "Capacities and rerun")
+        viol_g = int(out["viol_g"])
+        n_levels = int(out["n_levels"])
+        levels_arr = [1] + [int(x) for x in
+                            np.asarray(out["levels"][:n_levels]) if int(x) > 0]
+        if viol_g >= 0 and len(levels_arr) > 1:
+            # refbfs never records the partially-explored violating level;
+            # drop it so violation-run diameters agree across all checkers.
+            levels_arr = levels_arr[:-1]
+        cov_arr = np.asarray(out["coverage"])
+        coverage: Counter = Counter()
+        for a, inst in enumerate(self.table):
+            if cov_arr[a]:
+                coverage[inst.family] += int(cov_arr[a])
+
+        violation = None
+        if viol_g >= 0:
+            violation = self._extract_trace(out, viol_g)
+
+        return EngineResult(
+            n_states=n_states,
+            diameter=len(levels_arr) - 1,
+            n_transitions=int(out["n_transitions"]),
+            coverage=coverage,
+            violation=violation,
+            levels=levels_arr,
+            wall_s=time.monotonic() - t0)
+
+    def _extract_trace(self, out, viol_g: int) -> Violation:
+        """Two extra transfers: parent/lane links, then the chain's rows."""
+        n = viol_g + 1
+        parent = np.asarray(out["parent"][:n])
+        lane = np.asarray(out["lane"][:n])
+        chain_idx = []
+        cur = viol_g
+        while cur >= 0:
+            chain_idx.append(cur)
+            cur = int(parent[cur])
+        chain_idx.reverse()
+        rows = np.asarray(out["store"][jnp.asarray(chain_idx)])
+        chain = []
+        for k, g in enumerate(chain_idx):
+            py = interp.from_struct(
+                st.unpack(rows[k], self.lay, np), self.bounds)
+            label = self.table[int(lane[g])].label() if g > 0 else None
+            chain.append((label, py))
+        inv_name = self.config.invariants[int(out["viol_i"])]
+        return Violation(invariant=inv_name, state=chain[-1][1], trace=chain)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_engine(config: CheckConfig, caps: Capacities) -> DeviceEngine:
+    return DeviceEngine(config, caps)
+
+
+def check(config: CheckConfig, caps: Capacities | None = None,
+          **kw) -> EngineResult:
+    """One-shot convenience mirroring ``engine.check`` / ``refbfs.check``."""
+    return _cached_engine(config, caps or Capacities()).check(**kw)

@@ -48,13 +48,16 @@ def _fmix32(h, xp):
 
 def fingerprint(vec, consts, xp):
     """Canonical int32[..., W] -> (hi, lo) uint32 lanes, shape [...]."""
-    w = vec.astype(xp.uint32)
-    c1 = consts[0].astype(xp.uint32)
-    c2 = consts[1].astype(xp.uint32)
-    s1 = xp.sum(w * c1, axis=-1, dtype=xp.uint32)
-    s2 = xp.sum(w * c2, axis=-1, dtype=xp.uint32)
-    h1 = _fmix32(s1 + _LANE_SEEDS[0], xp)
-    h2 = _fmix32(s2 + _LANE_SEEDS[1], xp)
+    # uint32 wraparound is the *point* of the arithmetic; silence NumPy's
+    # scalar-overflow warning (no-op under jnp, which never warns).
+    with np.errstate(over="ignore"):
+        w = vec.astype(xp.uint32)
+        c1 = consts[0].astype(xp.uint32)
+        c2 = consts[1].astype(xp.uint32)
+        s1 = xp.sum(w * c1, axis=-1, dtype=xp.uint32)
+        s2 = xp.sum(w * c2, axis=-1, dtype=xp.uint32)
+        h1 = _fmix32(s1 + _LANE_SEEDS[0], xp)
+        h2 = _fmix32(s2 + _LANE_SEEDS[1], xp)
     return h1, h2
 
 

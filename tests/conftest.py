@@ -13,3 +13,11 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The deployment image pre-imports jax from a sitecustomize hook with
+# JAX_PLATFORMS pinned to the real-TPU plugin, so the env var above is read
+# too late — override through the live config instead (backends initialize
+# lazily, so this still wins as long as no test touched a device yet).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,127 @@
+"""Device-resident engine ≡ host engine ≡ oracle (SURVEY §4.3).
+
+The full-jit search (device_engine.py) must reproduce refbfs exactly:
+distinct-state counts, diameter, per-level counts, per-action coverage,
+transition counts, invariant verdicts, and replayable counterexample traces.
+"""
+
+import pytest
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu import device_engine
+from raft_tla_tpu.device_engine import Capacities, DeviceEngine
+from raft_tla_tpu.models import interp, refbfs, spec as S
+from raft_tla_tpu.ops import msgbits as mb
+
+CAPS = Capacities(n_states=1 << 15, levels=64)
+
+
+def bag(*ms):
+    return tuple(sorted((m, 1) for m in ms))
+
+
+def assert_parity(cfg, caps=CAPS, **kw):
+    ref = refbfs.check(cfg, **kw)
+    got = DeviceEngine(cfg, caps).check(**kw)
+    assert got.n_states == ref.n_states
+    assert got.diameter == ref.diameter
+    assert got.levels == ref.levels
+    assert got.n_transitions == ref.n_transitions
+    assert got.coverage == ref.coverage
+    assert (got.violation is None) == (ref.violation is None)
+    return ref, got
+
+
+def test_election_2server_parity():
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",), chunk=64)
+    _, got = assert_parity(cfg)
+    assert got.violation is None and got.n_states > 10
+
+
+def test_election_3server_parity():
+    cfg = CheckConfig(bounds=Bounds(n_servers=3, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=1),
+                      spec="election",
+                      invariants=("NoTwoLeaders", "CommittedWithinLog"),
+                      chunk=1024)
+    _, got = assert_parity(cfg, caps=Capacities(n_states=1 << 18, levels=64))
+    assert got.violation is None and got.n_states > 1000
+
+
+def test_full_spec_small_parity():
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=1, max_msgs=2),
+                      spec="full",
+                      invariants=("NoTwoLeaders", "LogMatching",
+                                  "CommittedWithinLog"),
+                      chunk=128)
+    _, got = assert_parity(cfg, caps=Capacities(n_states=1 << 16, levels=64))
+    assert got.violation is None
+    for fam in (S.RESTART, S.DUPLICATE, S.DROP):
+        assert got.coverage[fam] > 0
+
+
+def test_replication_parity_from_leader():
+    bounds = Bounds(n_servers=3, n_values=1, max_term=2, max_log=1,
+                    max_msgs=2)
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.FOLLOWER),
+        term=(2, 2, 2), votedFor=(1, 1, 1))
+    cfg = CheckConfig(bounds=bounds, spec="replication",
+                      invariants=("LogMatching", "CommittedWithinLog"),
+                      chunk=256)
+    _, got = assert_parity(cfg, init_override=start)
+    assert got.violation is None and got.coverage[S.ADVANCECOMMIT] > 0
+
+
+def test_violation_trace_replayable():
+    """Seeded NaiveNoTwoLeaders violation: the device trace must replay."""
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=4, max_dup=1)
+    cfg = CheckConfig(bounds=bounds, spec="election",
+                      invariants=("NaiveNoTwoLeaders",), chunk=256)
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3),
+        votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100),
+        msgs=bag(mb.rv_response(3, 1, 1, 2)),
+    )
+    ref = refbfs.check(cfg, init_override=start)
+    got = DeviceEngine(cfg, CAPS).check(init_override=start)
+    assert got.violation is not None and ref.violation is not None
+    # same invariant, same first-in-discovery-order violating state
+    assert got.violation.invariant == "NaiveNoTwoLeaders"
+    assert got.violation.state == ref.violation.state
+    assert len(got.violation.trace) == len(ref.violation.trace)
+    # violation-run stats agree with the oracle too
+    assert got.levels == ref.levels
+    assert got.diameter == ref.diameter
+    trace = got.violation.trace
+    assert trace[0][0] is None and trace[0][1] == start
+    for (_l, prev), (_label, cur) in zip(trace, trace[1:]):
+        succs = [t for _i, t in interp.successors(prev, bounds,
+                                                  spec="election")]
+        assert cur in succs
+
+
+def test_chunk_size_invariance():
+    b = Bounds(n_servers=2, n_values=1, max_term=2, max_log=0, max_msgs=2)
+    r = {}
+    for chunk in (16, 256):
+        cfg = CheckConfig(bounds=b, spec="election",
+                          invariants=("NoTwoLeaders",), chunk=chunk)
+        r[chunk] = DeviceEngine(cfg, CAPS).check()
+    assert r[16].n_states == r[256].n_states
+    assert r[16].levels == r[256].levels
+    assert r[16].coverage == r[256].coverage
+
+
+def test_store_overflow_is_loud():
+    cfg = CheckConfig(bounds=Bounds(n_servers=3, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=1),
+                      spec="election", invariants=(), chunk=64)
+    with pytest.raises(RuntimeError, match="capacity"):
+        DeviceEngine(cfg, Capacities(n_states=256, levels=64)).check()
