@@ -1,0 +1,2 @@
+from raft_tla_tpu.parallel.shard_engine import (  # noqa: F401
+    ShardCapacities, ShardEngine, check, make_mesh)

@@ -1,0 +1,418 @@
+"""Multi-chip sharded BFS engine — L4 over ICI (SURVEY §7.1 step 7, §2.9).
+
+The reference is single-process (TLC's distributed mode is unused —
+SURVEY §2.9); this module is the scale-out design the task demands, built the
+TPU way: ``jax.sharding.Mesh`` + ``shard_map`` + XLA collectives, not
+NCCL/MPI.  The whole multi-device search is still **one jitted computation**
+(the device_engine.py architecture), with three collectives in the hot loop:
+
+- **all_to_all** — fingerprint-prefix dedup exchange (SURVEY §2.9 row SP):
+  every chip owns the slice of fingerprint space ``fp_hi % n_dev == d``.
+  After a chip expands a chunk of its local frontier, each candidate
+  successor is routed to its owner chip, which alone consults/updates its
+  local fingerprint table.  Because a state's owner is a pure function of its
+  fingerprint, a state is only ever deduplicated in one place — no global
+  table, no host round-trips.
+- **pmax** — lockstep chunk scheduling: devices run the same number of chunk
+  iterations per level (all_to_all requires all participants), idle rows
+  masked off.
+- **psum** — termination detection (frontier empty everywhere), violation
+  broadcast, level histograms, coverage and transition totals.
+
+Data placement per device (all static shapes): its shard of the store
+(states it owns, in local discovery order), parent **global ids**
+(``dev * n_states_cap + local_idx`` — trace chains cross chips), lane ids,
+constraint flags, and the local fingerprint table.  The frontier is a
+contiguous store segment per device, exactly as in device_engine.py — BFS is
+level-synchronous, and new states append to their owner's store.
+
+Load balance comes from the hash: fingerprints are avalanche-mixed
+(ops/fingerprint.py), so each chip owns ~1/n of every level's new states.
+This is the checker's DP axis; the per-state action fan-out is its TP axis
+(SURVEY §2.9).
+
+Determinism: within a device, candidate order is (sender device, send slot) —
+fixed — so parent links and local discovery order are reproducible run to
+run.  Global discovery order differs from the single-chip engines (states
+interleave across chips), so total counts, per-level counts, transition
+counts, verdicts and diameter all match refbfs/DeviceEngine exactly, while
+(a) a violation trace may be a *different valid counterexample* than the
+single-chip one (still replayable — tested), and (b) per-action coverage
+*attribution* can differ when the same new state is producible by several
+actions within one level — the first discoverer gets credit, and "first"
+depends on interleaving.  Coverage *totals* still equal n_states - 1
+(every non-initial state credited exactly once); TLC's own multi-worker
+mode has the same attribution nondeterminism.
+
+Differences vs TLC's distributed mode (Java sockets, central fingerprint
+server): here dedup is sharded, not centralized, and the exchange is a
+single fused collective per chunk on the ICI fabric.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from collections import Counter
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from raft_tla_tpu.config import CheckConfig
+from raft_tla_tpu.device_engine import _EMPTY, _dedup_insert
+from raft_tla_tpu.engine import EngineResult, Violation
+from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
+from raft_tla_tpu.ops import fingerprint as fpr
+from raft_tla_tpu.ops import kernels
+from raft_tla_tpu.ops import state as st
+
+I32 = jnp.int32
+U32 = jnp.uint32
+_AXIS = "d"     # the frontier/fingerprint mesh axis (DP, SURVEY §2.9)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardCapacities:
+    """Static shapes of one compiled sharded search (per-device where noted).
+
+    ``send`` is the per-destination routing buffer depth per chunk; ``None``
+    means the safe bound ``chunk * A`` (no overflow possible).  Smaller
+    values trade memory for a loud abort if one chip's candidates concentrate
+    on one owner (hash-uniform, so ~BA/n expected).
+    """
+
+    n_states: int = 1 << 17      # store rows per device
+    levels: int = 256
+    send: Optional[int] = None
+
+    @property
+    def table(self) -> int:      # per-device hash slots, load factor <= 0.5
+        return 1 << (2 * self.n_states - 1).bit_length()
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devs)} "
+                "(tests: --xla_force_host_platform_device_count)")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (_AXIS,))
+
+
+def _build_sharded_search(config: CheckConfig, caps: ShardCapacities,
+                          A: int, W: int, ndev: int):
+    """The per-device program; run under shard_map over the ``d`` axis."""
+    B = config.chunk
+    n_inv = len(config.invariants)
+    if n_inv > 29:
+        raise ValueError("at most 29 invariants (bit-packed into int32 flags)")
+    step = kernels.build_step(config.bounds, config.spec,
+                              tuple(config.invariants))
+    Ncap, Lcap, Tcap = caps.n_states, caps.levels, caps.table
+    Csend = caps.send if caps.send is not None else B * A
+    BIG = jnp.int32(np.iinfo(np.int32).max)
+
+    def owner(key_hi):
+        """FP-prefix shard map: which device dedups/stores this state."""
+        return (key_hi % jnp.uint32(ndev)).astype(I32)
+
+    def chunk_body(carry, c):
+        (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail, stop) = carry
+        dev = jax.lax.axis_index(_AXIS).astype(I32)
+
+        # ---- expand my chunk (rows may be inactive on ragged levels) ----
+        start = lvl_start + c * B
+        gstart = jnp.clip(start, 0, Ncap - B)
+        rows_l = gstart + jnp.arange(B, dtype=I32)
+        row_act = (rows_l >= start) & (rows_l < lvl_end)
+        vecs = jax.lax.dynamic_slice(store, (gstart, 0), (B, W))
+        out = step(vecs)
+        con_par = jax.lax.dynamic_slice(conflag, (gstart,), (B,))
+        valid = out["valid"] & row_act[:, None] & con_par[:, None]
+        n_trans = n_trans + jnp.sum(valid.astype(I32))
+        fail = fail | jnp.any(valid & out["overflow"])
+
+        # ---- route candidates to their fingerprint owners ----
+        BA = B * A
+        fhi = out["fp_hi"].reshape(BA)
+        flo = out["fp_lo"].reshape(BA)
+        fvalid = valid.reshape(BA)
+        dest = jnp.where(fvalid, owner(fhi), ndev)
+        oh = (dest[:, None] == jnp.arange(ndev, dtype=I32)[None, :])
+        cum = jnp.cumsum(oh.astype(I32), axis=0)
+        pos = jnp.take_along_axis(
+            cum, jnp.clip(dest, 0, ndev - 1)[:, None], axis=1)[:, 0] - 1
+        fail = fail | jnp.any(fvalid & (pos >= Csend))   # routing overflow
+        slot = jnp.where(fvalid & (pos < Csend), dest * Csend + pos,
+                         ndev * Csend)
+
+        flat_b = jnp.arange(BA, dtype=I32) // A
+        flat_a = jnp.arange(BA, dtype=I32) % A
+        # flags: bit0 occupied, bit1 con_ok, bits 2.. per-invariant ok
+        flags = jnp.ones((BA,), I32) | (
+            out["con_ok"].reshape(BA).astype(I32) << 1)
+        if n_inv:
+            iv = out["inv_ok"].reshape(BA, n_inv).astype(I32)
+            flags = flags | jnp.sum(
+                iv << (2 + jnp.arange(n_inv, dtype=I32))[None, :], axis=1)
+
+        def scatter(val, fill, dtype):
+            buf = jnp.full((ndev * Csend,) + val.shape[1:], fill, dtype)
+            return buf.at[slot].set(val.astype(dtype), mode="drop")
+
+        svecs = out["svecs"].reshape(BA, W)
+        s_vec = scatter(svecs, 0, I32).reshape(ndev, Csend, W)
+        s_hi = scatter(fhi, _EMPTY, U32).reshape(ndev, Csend)
+        s_lo = scatter(flo, _EMPTY, U32).reshape(ndev, Csend)
+        s_par = scatter(dev * Ncap + gstart + flat_b, -1, I32).reshape(
+            ndev, Csend)
+        s_lane = scatter(flat_a, -1, I32).reshape(ndev, Csend)
+        s_flags = scatter(flags, 0, I32).reshape(ndev, Csend)
+
+        a2a = functools.partial(jax.lax.all_to_all, axis_name=_AXIS,
+                                split_axis=0, concat_axis=0, tiled=True)
+        r_vec = a2a(s_vec).reshape(ndev * Csend, W)
+        r_hi = a2a(s_hi).reshape(ndev * Csend)
+        r_lo = a2a(s_lo).reshape(ndev * Csend)
+        r_par = a2a(s_par).reshape(ndev * Csend)
+        r_lane = a2a(s_lane).reshape(ndev * Csend)
+        r_flags = a2a(s_flags).reshape(ndev * Csend)
+        active = (r_flags & 1) == 1
+
+        # ---- owner-side dedup + append (same protocol as device_engine) ----
+        tbl_hi, tbl_lo, is_new, pfail = _dedup_insert(
+            tbl_hi, tbl_lo, r_hi, r_lo, active)
+        fail = fail | pfail
+        pos_st = n_states + jnp.cumsum(is_new.astype(I32)) - 1
+        sl = jnp.where(is_new & (pos_st < Ncap), pos_st, Ncap)
+        store = store.at[sl].set(r_vec, mode="drop")
+        parent = parent.at[sl].set(r_par, mode="drop")
+        lane = lane.at[sl].set(r_lane, mode="drop")
+        conflag = conflag.at[sl].set(((r_flags >> 1) & 1) == 1, mode="drop")
+        cov = cov.at[jnp.where(is_new, r_lane, A)].add(1, mode="drop")
+        n_new = jnp.sum(is_new.astype(I32))
+        fail = fail | (n_states + n_new > Ncap)
+        n_states = jnp.minimum(n_states + n_new, Ncap)
+
+        # ---- first invariant violation among my new states ----
+        if n_inv:
+            inv_bits = (r_flags >> 2) & ((1 << n_inv) - 1)
+            inv_bad = is_new & (inv_bits != (1 << n_inv) - 1)
+        else:
+            inv_bad = jnp.zeros_like(is_new)
+        first = jnp.min(jnp.where(
+            inv_bad, jnp.arange(ndev * Csend, dtype=I32), BIG))
+        new_viol = (first < BIG) & (viol_g < 0)
+        fidx = jnp.minimum(first, ndev * Csend - 1)
+        viol_g = jnp.where(new_viol, dev * Ncap + pos_st[fidx], viol_g)
+        if n_inv:
+            bad_inv = jnp.argmax(
+                ((r_flags[fidx] >> 2) & (1 << jnp.arange(n_inv))) == 0
+            ).astype(I32)
+        else:
+            bad_inv = jnp.int32(0)
+        viol_i = jnp.where(new_viol, bad_inv, viol_i)
+
+        # replicated stop flag: any device saw a violation or failed
+        stop = (jax.lax.psum((viol_g >= 0).astype(I32), _AXIS) > 0) | \
+            (jax.lax.pmax(fail.astype(I32), _AXIS) > 0)
+        return (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+                lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail, stop)
+
+    def level_body(carry):
+        (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail, stop,
+         levels, lvl) = carry
+        # lockstep chunk count across devices (all_to_all needs everyone)
+        n_act = lvl_end - lvl_start
+        n_chunks = jax.lax.pmax((n_act + B - 1) // B, _AXIS)
+
+        def ccond(c_carry):
+            c, inner = c_carry
+            return (c < n_chunks) & ~inner[14]
+
+        def cbody(c_carry):
+            c, inner = c_carry
+            return c + 1, chunk_body(inner, c)
+
+        inner = (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+                 lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
+                 jnp.bool_(False))
+        _, inner = jax.lax.while_loop(ccond, cbody, (jnp.int32(0), inner))
+        (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
+         stop) = inner
+        n_new_tot = jax.lax.psum(n_states - lvl_end, _AXIS)  # replicated
+        levels = levels.at[jnp.minimum(lvl, Lcap - 1)].set(n_new_tot)
+        fail = fail | ((lvl >= Lcap - 1) & (n_new_tot > 0))
+        stop = stop | (jax.lax.pmax(fail.astype(I32), _AXIS) > 0) | \
+            (n_new_tot == 0)
+        return (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+                lvl_end, n_states, viol_g, viol_i, n_trans, cov, fail,
+                stop, levels, lvl + 1)
+
+    def level_cond(carry):
+        stop = carry[14]
+        return ~stop
+
+    def search(init_vec, init_hi, init_lo, init_con):
+        """Per-device program.  Scalar inputs are replicated."""
+        dev = jax.lax.axis_index(_AXIS).astype(I32)
+        mine = owner(init_hi) == dev
+        store = jnp.zeros((Ncap, W), I32).at[0].set(
+            jnp.where(mine, init_vec, 0))
+        parent = jnp.full((Ncap,), -1, I32)
+        lane = jnp.full((Ncap,), -1, I32)
+        conflag = jnp.zeros((Ncap,), bool).at[0].set(mine & init_con)
+        islot = (init_lo & jnp.uint32(Tcap - 1)).astype(I32)
+        tbl_hi = jnp.full((Tcap,), _EMPTY, U32).at[islot].set(
+            jnp.where(mine, init_hi, _EMPTY))
+        tbl_lo = jnp.full((Tcap,), _EMPTY, U32).at[islot].set(
+            jnp.where(mine, init_lo, _EMPTY))
+        levels = jnp.zeros((Lcap,), I32)
+        n0 = jnp.where(mine, 1, 0).astype(I32)
+        carry = (store, parent, lane, conflag, tbl_hi, tbl_lo,
+                 n0, jnp.int32(0), n0,
+                 jnp.int32(-1), jnp.int32(0), jnp.int32(0),
+                 jnp.zeros((A,), I32), jnp.bool_(False), jnp.bool_(False),
+                 levels, jnp.int32(1))
+        carry = jax.lax.while_loop(level_cond, level_body, carry)
+        (store, parent, lane, conflag, _th, _tl, n_states, _ls, _le,
+         viol_g, viol_i, n_trans, cov, fail, _stop, levels, lvl) = carry
+        return {
+            # sharded outputs (global view is the concatenation over devices)
+            "store": store, "parent": parent, "lane": lane,
+            "n_states": n_states[None], "viol_g": viol_g[None],
+            "viol_i": viol_i[None], "fail": fail[None],
+            # replicated outputs
+            "n_transitions": jax.lax.psum(n_trans, _AXIS),
+            "coverage": jax.lax.psum(cov, _AXIS),
+            "levels": levels, "n_levels": lvl,
+        }
+
+    return search
+
+
+class ShardEngine:
+    """One compiled multi-device exhaustive checker; reusable across runs."""
+
+    def __init__(self, config: CheckConfig, mesh: Mesh | None = None,
+                 caps: ShardCapacities | None = None):
+        self.config = config
+        self.bounds = config.bounds
+        self.lay = st.Layout.of(self.bounds)
+        self.table = S.action_table(self.bounds, config.spec)
+        self.A = len(self.table)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.ndev = self.mesh.devices.size
+        self.caps = caps or ShardCapacities()
+        if self.caps.n_states < config.chunk:
+            raise ValueError("ShardCapacities.n_states must be >= chunk")
+        fn = _build_sharded_search(config, self.caps, self.A,
+                                   self.lay.width, self.ndev)
+        sharded = {"store": P(_AXIS), "parent": P(_AXIS), "lane": P(_AXIS),
+                   "n_states": P(_AXIS), "viol_g": P(_AXIS),
+                   "viol_i": P(_AXIS), "fail": P(_AXIS)}
+        out_specs = {k: sharded.get(k, P()) for k in (
+            "store", "parent", "lane", "n_states", "viol_g", "viol_i",
+            "fail", "n_transitions", "coverage", "levels", "n_levels")}
+        self._search = jax.jit(jax.shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(P(), P(), P(), P()),   # replicated init
+            out_specs=out_specs, check_vma=False))
+
+    def check(self, init_override: interp.PyState | None = None
+              ) -> EngineResult:
+        t0 = time.monotonic()
+        bounds = self.bounds
+        init_py = init_override if init_override is not None \
+            else interp.init_state(bounds)
+        init_vec = interp.to_vec(init_py, bounds)
+        consts = fpr.lane_constants(self.lay.width)
+        hi0, lo0 = fpr.fingerprint(init_vec.astype(np.int32), consts, np)
+
+        for nm in self.config.invariants:
+            if not inv_mod.py_invariant(nm)(init_py, bounds):
+                return EngineResult(
+                    n_states=1, diameter=0, n_transitions=0,
+                    coverage=Counter(),
+                    violation=Violation(nm, init_py, [(None, init_py)]),
+                    levels=[1], wall_s=time.monotonic() - t0)
+
+        out = self._search(jnp.asarray(init_vec, I32), jnp.uint32(hi0),
+                           jnp.uint32(lo0),
+                           jnp.bool_(interp.constraint_ok(init_py, bounds)))
+        n_states = int(np.asarray(out["n_states"]).sum())
+        if bool(np.asarray(out["fail"]).any()):
+            raise RuntimeError(
+                "sharded search aborted: store/level/probe/routing capacity "
+                f"exceeded (caps={self.caps}, ndev={self.ndev}) — grow "
+                "ShardCapacities and rerun")
+        viol_gs = np.asarray(out["viol_g"])
+        viol_devs = np.nonzero(viol_gs >= 0)[0]
+        n_levels = int(out["n_levels"])
+        levels_arr = [1] + [int(x) for x in
+                            np.asarray(out["levels"][:n_levels]) if int(x) > 0]
+        if viol_devs.size and len(levels_arr) > 1:
+            levels_arr = levels_arr[:-1]    # violating level is partial
+        cov_arr = np.asarray(out["coverage"])
+        coverage: Counter = Counter()
+        for a, inst in enumerate(self.table):
+            if cov_arr[a]:
+                coverage[inst.family] += int(cov_arr[a])
+
+        violation = None
+        if viol_devs.size:
+            d = int(viol_devs[0])
+            violation = self._extract_trace(
+                out, int(viol_gs[d]), int(np.asarray(out["viol_i"])[d]))
+
+        return EngineResult(
+            n_states=n_states,
+            diameter=len(levels_arr) - 1,
+            n_transitions=int(out["n_transitions"]),
+            coverage=coverage,
+            violation=violation,
+            levels=levels_arr,
+            wall_s=time.monotonic() - t0)
+
+    def _extract_trace(self, out, viol_g: int, viol_i: int) -> Violation:
+        """Walk the cross-device parent chain through the global arrays."""
+        parent = np.asarray(out["parent"])   # [ndev * Ncap]
+        lane = np.asarray(out["lane"])
+        chain_idx = []
+        cur = viol_g
+        while cur >= 0:
+            chain_idx.append(cur)
+            cur = int(parent[cur])
+        chain_idx.reverse()
+        rows = np.asarray(out["store"][jnp.asarray(chain_idx)])
+        chain = []
+        for k, g in enumerate(chain_idx):
+            py = interp.from_struct(
+                st.unpack(rows[k], self.lay, np), self.bounds)
+            label = self.table[int(lane[g])].label() if k > 0 else None
+            chain.append((label, py))
+        inv_name = self.config.invariants[viol_i]
+        return Violation(invariant=inv_name, state=chain[-1][1], trace=chain)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_engine(config: CheckConfig, mesh: Mesh,
+                   caps: ShardCapacities) -> ShardEngine:
+    return ShardEngine(config, mesh, caps)
+
+
+def check(config: CheckConfig, mesh: Mesh | None = None,
+          caps: ShardCapacities | None = None, **kw) -> EngineResult:
+    """One-shot convenience mirroring the other engines' ``check``."""
+    return _cached_engine(config, mesh if mesh is not None else make_mesh(),
+                          caps or ShardCapacities()).check(**kw)

@@ -1,0 +1,111 @@
+"""Sharded multi-device engine ≡ oracle (SURVEY §4.3-§4.4).
+
+Runs on the 8-device virtual CPU mesh (conftest.py) — the checker's
+"multi-node without a cluster" story.  Exploration metrics (state counts,
+per-level counts, diameter, transition counts, verdicts) must match refbfs
+exactly; per-action coverage matches in total (attribution is interleaving-
+dependent — see shard_engine.py module docstring).
+"""
+
+import pytest
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.models import interp, refbfs, spec as S
+from raft_tla_tpu.ops import msgbits as mb
+from raft_tla_tpu.parallel import ShardCapacities, ShardEngine, make_mesh
+
+CAPS = ShardCapacities(n_states=1 << 12, levels=64)
+
+
+def bag(*ms):
+    return tuple(sorted((m, 1) for m in ms))
+
+
+def assert_parity(cfg, ndev=8, caps=CAPS, **kw):
+    ref = refbfs.check(cfg, **kw)
+    got = ShardEngine(cfg, make_mesh(ndev), caps).check(**kw)
+    assert got.n_states == ref.n_states
+    assert got.diameter == ref.diameter
+    assert got.levels == ref.levels
+    assert got.n_transitions == ref.n_transitions
+    assert sum(got.coverage.values()) == sum(ref.coverage.values())
+    assert (got.violation is None) == (ref.violation is None)
+    return ref, got
+
+
+def test_election_2server_parity_8dev():
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",), chunk=64)
+    _, got = assert_parity(cfg)
+    assert got.violation is None and got.n_states > 1000
+
+
+def test_full_spec_small_parity_8dev():
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=1, max_msgs=2),
+                      spec="full",
+                      invariants=("NoTwoLeaders", "LogMatching",
+                                  "CommittedWithinLog"),
+                      chunk=128)
+    _, got = assert_parity(cfg, caps=ShardCapacities(n_states=1 << 14,
+                                                     levels=64))
+    assert got.violation is None
+    for fam in (S.RESTART, S.DUPLICATE, S.DROP):
+        assert got.coverage[fam] > 0
+
+
+def test_ndev_invariance():
+    """1-, 2- and 8-chip meshes explore the identical state graph."""
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",), chunk=32)
+    runs = {n: ShardEngine(cfg, make_mesh(n), CAPS).check()
+            for n in (1, 2, 8)}
+    base = runs[1]
+    for n, r in runs.items():
+        assert r.n_states == base.n_states, n
+        assert r.levels == base.levels, n
+        assert r.n_transitions == base.n_transitions, n
+
+
+def test_violation_trace_replayable_8dev():
+    """Seeded NaiveNoTwoLeaders violation: the cross-chip trace must replay.
+
+    The trace may be a different counterexample than refbfs's (discovery
+    interleaving), but it must start at Init, follow real transitions, and
+    end in a state violating the same invariant.
+    """
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=4, max_dup=1)
+    cfg = CheckConfig(bounds=bounds, spec="election",
+                      invariants=("NaiveNoTwoLeaders",), chunk=256)
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3),
+        votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100),
+        msgs=bag(mb.rv_response(3, 1, 1, 2)),
+    )
+    got = ShardEngine(cfg, make_mesh(8), CAPS).check(init_override=start)
+    assert got.violation is not None
+    assert got.violation.invariant == "NaiveNoTwoLeaders"
+    trace = got.violation.trace
+    assert trace[0][0] is None and trace[0][1] == start
+    for (_l, prev), (_label, cur) in zip(trace, trace[1:]):
+        succs = [t for _i, t in interp.successors(prev, bounds,
+                                                  spec="election")]
+        assert cur in succs
+    from raft_tla_tpu.models import invariants as inv_mod
+    assert not inv_mod.py_invariant("NaiveNoTwoLeaders")(
+        got.violation.state, bounds)
+
+
+def test_routing_overflow_is_loud():
+    """A send buffer too small for one owner's share must abort, not clamp."""
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=(), chunk=64)
+    caps = ShardCapacities(n_states=1 << 12, levels=64, send=1)
+    with pytest.raises(RuntimeError, match="capacity"):
+        ShardEngine(cfg, make_mesh(8), caps).check()
