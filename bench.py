@@ -1,0 +1,107 @@
+"""Headline benchmark: exhaustive model checking throughput on one chip.
+
+Runs the device-resident checker (``raft_tla_tpu.device_engine``) over a
+fixed suite of exhaustively-checkable Raft models (election sub-spec and the
+full ``Next`` with crash/duplicate/drop faults — BASELINE.md configs #2/#4
+scaled to single-chip HBM), invariants on, and reports warm throughput.
+Each suite entry runs in its own subprocess: building several engines in one
+process can wedge the TPU worker (see .claude/skills/verify/SKILL.md).
+
+The reference publishes no performance numbers (BASELINE.md: ``"published":
+{}``), so ``vs_baseline`` is measured against the driver's north-star budget:
+the BASELINE.json target of an exhaustive, invariant-checked run in under
+60 s.  ``vs_baseline = 60 / suite_wall_s`` — > 1 means the whole suite
+finishes inside the north-star budget.
+
+Prints exactly one JSON line on stdout; human detail goes to stderr.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+# Single source of truth for the suite; configs are built lazily in the
+# child so the parent never imports jax.
+SUITE_NAMES = ("election-3s", "full-2s-faults")
+SUITE_SIZE = len(SUITE_NAMES)
+
+
+def _suite():
+    from raft_tla_tpu.config import Bounds, CheckConfig
+    from raft_tla_tpu.device_engine import Capacities
+
+    suite = (
+        # (name, config, store capacity) — all verified to complete.
+        ("election-3s",
+         CheckConfig(bounds=Bounds(n_servers=3, n_values=1, max_term=2,
+                                   max_log=0, max_msgs=1),
+                     spec="election",
+                     invariants=("NoTwoLeaders", "CommittedWithinLog"),
+                     chunk=1024),
+         Capacities(n_states=1 << 18, levels=64)),
+        ("full-2s-faults",
+         CheckConfig(bounds=Bounds(n_servers=2, n_values=2, max_term=2,
+                                   max_log=1, max_msgs=2, max_dup=1),
+                     spec="full",
+                     invariants=("NoTwoLeaders", "LogMatching",
+                                 "CommittedWithinLog"),
+                     chunk=1024),
+         Capacities(n_states=1 << 17, levels=64)),
+    )
+    assert tuple(e[0] for e in suite) == SUITE_NAMES
+    return suite
+
+
+def run_one(idx: int) -> None:
+    """Child process: run suite entry ``idx``, print its JSON to stdout."""
+    from raft_tla_tpu.device_engine import DeviceEngine
+
+    name, cfg, caps = _suite()[idx]
+    eng = DeviceEngine(cfg, caps)
+    eng.check()                  # compile + cold run
+    t0 = time.monotonic()
+    r = eng.check()              # warm, timed
+    wall = time.monotonic() - t0
+    print(json.dumps({
+        "name": name, "n_states": r.n_states, "diameter": r.diameter,
+        "wall_s": wall, "violation": r.violation is not None,
+    }))
+
+
+def main() -> None:
+    total_states = 0
+    total_wall = 0.0
+    for idx in range(SUITE_SIZE):
+        proc = subprocess.run(
+            [sys.executable, __file__, "--one", str(idx)],
+            capture_output=True, text=True, timeout=900)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+            print(f"bench entry {idx} failed", file=sys.stderr)
+            sys.exit(1)
+        r = json.loads(proc.stdout.strip().splitlines()[-1])
+        if r["violation"]:
+            print(f"bench {r['name']}: unexpected invariant violation",
+                  file=sys.stderr)
+            sys.exit(1)
+        total_states += r["n_states"]
+        total_wall += r["wall_s"]
+        print(f"{r['name']}: {r['n_states']} states, diameter "
+              f"{r['diameter']}, {r['wall_s']:.2f}s warm "
+              f"({r['n_states'] / r['wall_s']:,.0f} states/s)",
+              file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "exhaustive_check_states_per_sec_single_chip",
+        "value": round(total_states / total_wall, 1),
+        "unit": "states/s",
+        "vs_baseline": round(60.0 / total_wall, 2),
+    }))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "--one":
+        run_one(int(sys.argv[2]))
+    else:
+        main()

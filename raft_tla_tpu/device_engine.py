@@ -3,13 +3,21 @@
 ``engine.py`` proved the semantics with a host-side dedup loop; this module is
 the TPU-first redesign the hardware demands.  Measured on the deployment
 tunnel, every host↔device round trip costs ~0.7 s and every eager-op compile
-~10 s, so the only architecture that can hit the <60 s north star is one where
-**the entire breadth-first search is a single jitted computation**: the state
-store, the fingerprint table, the frontier, parent links, coverage counters
-and violation flags all live in HBM, and one ``jax.jit`` call runs the whole
-exploration with ``lax.while_loop`` over levels and chunks.  The host sees
-nothing until the search ends (stats + flags), then makes at most two more
+~10 s, so the architecture keeps **all search state resident in HBM**: the
+state store, the fingerprint table, the frontier, parent links, coverage
+counters and violation flags never leave the device.  The host sees nothing
+but a ``done`` scalar until the search ends, then makes at most two more
 gathers to reconstruct a counterexample trace.
+
+Execution is **segmented**: one jitted *segment* advances the search by up to
+``seg_chunks`` chunk expansions (crossing BFS-level boundaries freely) and
+returns the carry, whose buffers are **donated** back into the next segment
+call — zero copies, zero reallocation.  Segmenting exists because single XLA
+program executions are killed by the deployment tunnel's watchdog at roughly
+a minute of device time (measured empirically: ~25 s fine, ~2 min kills the
+TPU worker process); it also gives the host a natural place to snapshot the
+carry for checkpoint/resume and to report per-level progress (SURVEY §5).
+The search is resumable mid-level: the chunk cursor is part of the carry.
 
 Architecture (all shapes static — XLA's compilation model, SURVEY §7.2.4):
 
@@ -126,8 +134,39 @@ def _dedup_insert(tbl_hi, tbl_lo, key_hi, key_lo, active):
     return tbl_hi, tbl_lo, is_new, jnp.any(unres)
 
 
-def _build_search(config: CheckConfig, caps: Capacities, A: int, W: int):
-    """Trace the full search as one jittable function of the initial state."""
+# Failure bitmask (the "fail loudly" contract, SURVEY §4.5).
+FAIL_WIDTH = 1      # a successor exceeded a tensor-encoding capacity
+FAIL_PROBE = 2      # linear probe exceeded _MAX_PROBE (table too full)
+FAIL_STORE = 4      # more distinct states than Capacities.n_states
+FAIL_LEVEL = 8      # BFS deeper than Capacities.levels
+
+_FAIL_TEXT = {
+    FAIL_WIDTH: "state-width overflow (encoding capacity exceeded)",
+    FAIL_PROBE: "fingerprint-table probe overflow (table too full)",
+    FAIL_STORE: "state-store capacity exceeded",
+    FAIL_LEVEL: "BFS level capacity exceeded",
+}
+
+
+def decode_fail(fail_bits: int) -> str:
+    return "; ".join(txt for bit, txt in _FAIL_TEXT.items()
+                     if fail_bits & bit) or "unknown"
+
+
+def _carry_done(carry):
+    """Search-complete predicate over the segment carry."""
+    lvl_start, lvl_end, viol_g, fail = (carry[7], carry[8], carry[9],
+                                        carry[13])
+    return (lvl_end <= lvl_start) | (viol_g >= 0) | (fail != 0)
+
+
+def _build_segment(config: CheckConfig, caps: Capacities, A: int, W: int):
+    """One watchdog-safe slice of the search: ≤ ``budget`` chunk steps.
+
+    ``budget`` is a traced scalar, so the host can retune the segment length
+    every dispatch (targeting a fixed seconds-per-segment) without
+    recompiling.
+    """
     B = config.chunk
     n_inv = len(config.invariants)
     step = kernels.build_step(config.bounds, config.spec,
@@ -135,9 +174,10 @@ def _build_search(config: CheckConfig, caps: Capacities, A: int, W: int):
     Ncap, Lcap, Tcap = caps.n_states, caps.levels, caps.table
     BIG = jnp.int32(np.iinfo(np.int32).max)
 
-    def chunk_body(carry, c):
+    def chunk_body(carry):
         (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
-         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail) = carry
+         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
+         levels, lvl, c) = carry
         start = lvl_start + c * B
         gstart = jnp.minimum(start, Ncap - B)      # clamped window (see below)
         rows_g = gstart + jnp.arange(B, dtype=I32)
@@ -147,14 +187,14 @@ def _build_search(config: CheckConfig, caps: Capacities, A: int, W: int):
         con_par = jax.lax.dynamic_slice(conflag, (gstart,), (B,))
         valid = out["valid"] & row_act[:, None] & con_par[:, None]
         n_trans = n_trans + jnp.sum(valid.astype(I32))
-        fail = fail | jnp.any(valid & out["overflow"])        # capacity bug
+        fail = fail | jnp.any(valid & out["overflow"]) * FAIL_WIDTH
 
         fhi = out["fp_hi"].reshape(-1)
         flo = out["fp_lo"].reshape(-1)
         fvalid = valid.reshape(-1)
         tbl_hi, tbl_lo, is_new, pfail = _dedup_insert(
             tbl_hi, tbl_lo, fhi, flo, fvalid)
-        fail = fail | pfail
+        fail = fail | pfail * FAIL_PROBE
 
         # Append new states to the store in discovery order.
         pos = n_states + jnp.cumsum(is_new.astype(I32)) - 1
@@ -169,7 +209,7 @@ def _build_search(config: CheckConfig, caps: Capacities, A: int, W: int):
         cov = cov.at[jnp.where(is_new, flat_a, A)].add(1, mode="drop")
 
         n_new = jnp.sum(is_new.astype(I32))
-        fail = fail | (n_states + n_new > Ncap)               # store overflow
+        fail = fail | (n_states + n_new > Ncap) * FAIL_STORE
         n_states = jnp.minimum(n_states + n_new, Ncap)
 
         # First invariant violation among new states, in discovery order.
@@ -186,41 +226,63 @@ def _build_search(config: CheckConfig, caps: Capacities, A: int, W: int):
             [jnp.minimum(first, B * A - 1)]) if n_inv else jnp.int32(0)
         viol_i = jnp.where(new_viol, bad_inv, viol_i)
         return (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
-                lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail)
+                lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
+                levels, lvl, c + 1)
 
-    def level_body(carry):
-        (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
-         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
-         levels, lvl) = carry
-        n_act = lvl_end - lvl_start
+    def outer_body(sc):
+        """Run chunks until the level is exhausted or the budget runs out,
+        then (maybe) advance the level window — scalar selects only, so the
+        big buffers are never threaded through a conditional."""
+        steps, carry = sc
+        n_act = carry[8] - carry[7]
         n_chunks = (n_act + B - 1) // B
 
-        def ccond(c_carry):
-            c, inner = c_carry
-            return (c < n_chunks) & (inner[9] < 0) & ~inner[13]
+        def ccond(cc):
+            s, inner = cc
+            return ((inner[16] < n_chunks) & (inner[9] < 0) &
+                    (inner[13] == 0) & (s < budget))
 
-        def cbody(c_carry):
-            c, inner = c_carry
-            return c + 1, chunk_body(inner, c)
+        def cbody(cc):
+            s, inner = cc
+            return s + 1, chunk_body(inner)
 
-        inner = (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
-                 lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail)
-        _, inner = jax.lax.while_loop(ccond, cbody, (jnp.int32(0), inner))
+        steps, carry = jax.lax.while_loop(ccond, cbody, (steps, carry))
         (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
-         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail) = inner
+         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
+         levels, lvl, c) = carry
+        adv = (c >= n_chunks) & (viol_g < 0) & (fail == 0)
         n_new = n_states - lvl_end
-        levels = levels.at[jnp.minimum(lvl, Lcap - 1)].set(n_new)
-        fail = fail | (lvl >= Lcap - 1) & (n_new > 0)
-        return (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
-                lvl_end, n_states, viol_g, viol_i, n_trans, cov, fail,
-                levels, lvl + 1)
+        levels = levels.at[jnp.where(adv, jnp.minimum(lvl, Lcap - 1),
+                                     Lcap)].set(n_new, mode="drop")
+        fail = fail | (adv & (lvl >= Lcap - 1) & (n_new > 0)) * FAIL_LEVEL
+        lvl_start = jnp.where(adv, lvl_end, lvl_start)
+        lvl_end = jnp.where(adv, n_states, lvl_end)
+        lvl = jnp.where(adv, lvl + 1, lvl)
+        c = jnp.where(adv, 0, c)
+        return steps, (store, parent, lane, conflag, tbl_hi, tbl_lo,
+                       n_states, lvl_start, lvl_end, viol_g, viol_i,
+                       n_trans, cov, fail, levels, lvl, c)
 
-    def level_cond(carry):
-        (_s, _p, _l, _c, _th, _tl, _n, lvl_start, lvl_end, viol_g, _vi,
-         _nt, _cov, fail, _levels, _lvl) = carry
-        return (lvl_end > lvl_start) & (viol_g < 0) & ~fail
+    def outer_cond(sc):
+        steps, carry = sc
+        return (steps < budget) & ~_carry_done(carry)
 
-    def search(init_vec, init_key_hi, init_key_lo, init_con):
+    def segment(carry, budget_):
+        nonlocal budget
+        budget = budget_
+        _, carry = jax.lax.while_loop(outer_cond, outer_body,
+                                      (jnp.int32(0), carry))
+        return carry, _carry_done(carry)
+
+    budget = None
+    return segment
+
+
+def _build_init(caps: Capacities, A: int, W: int):
+    """The initial segment carry: Init in the store, its FP in the table."""
+    Ncap, Lcap, Tcap = caps.n_states, caps.levels, caps.table
+
+    def init(init_vec, init_key_hi, init_key_lo, init_con):
         store = jnp.zeros((Ncap, W), I32).at[0].set(init_vec)
         parent = jnp.full((Ncap,), -1, I32)
         lane = jnp.full((Ncap,), -1, I32)
@@ -230,27 +292,25 @@ def _build_search(config: CheckConfig, caps: Capacities, A: int, W: int):
         tbl_lo = jnp.full((Tcap,), _EMPTY, U32).at[
             (init_key_lo & jnp.uint32(Tcap - 1)).astype(I32)].set(init_key_lo)
         levels = jnp.zeros((Lcap,), I32)
-        carry = (store, parent, lane, conflag, tbl_hi, tbl_lo,
-                 jnp.int32(1), jnp.int32(0), jnp.int32(1),
-                 jnp.int32(-1), jnp.int32(0), jnp.int32(0),
-                 jnp.zeros((A,), I32), jnp.bool_(False),
-                 levels, jnp.int32(1))
-        carry = jax.lax.while_loop(level_cond, level_body, carry)
-        (store, parent, lane, conflag, _th, _tl, n_states, _ls, _le,
-         viol_g, viol_i, n_trans, cov, fail, levels, lvl) = carry
-        return {"store": store, "parent": parent, "lane": lane,
-                "n_states": n_states, "viol_g": viol_g, "viol_i": viol_i,
-                "n_transitions": n_trans, "coverage": cov, "fail": fail,
-                "levels": levels, "n_levels": lvl}
+        return (store, parent, lane, conflag, tbl_hi, tbl_lo,
+                jnp.int32(1), jnp.int32(0), jnp.int32(1),
+                jnp.int32(-1), jnp.int32(0), jnp.int32(0),
+                jnp.zeros((A,), I32), jnp.int32(0),
+                levels, jnp.int32(1), jnp.int32(0))
 
-    return search
+    return init
 
 
 class DeviceEngine:
     """One compiled exhaustive checker; reusable across runs."""
 
+    # Adaptive segment sizing: target seconds of device time per dispatch,
+    # far enough under the ~60 s watchdog to absorb a 2-3x misprediction.
+    SEG_TARGET_S = 8.0
+    SEG_MIN, SEG_MAX = 16, 1 << 16
+
     def __init__(self, config: CheckConfig, caps: Capacities | None = None,
-                 device=None):
+                 device=None, seg_chunks: int = 64):
         self.config = config
         self.bounds = config.bounds
         self.lay = st.Layout.of(self.bounds)
@@ -262,8 +322,13 @@ class DeviceEngine:
         # jit follows input placement; ``device`` (None = default backend)
         # is applied to the four small inputs in check().
         self.device = device
-        self._search = jax.jit(
-            _build_search(config, self.caps, self.A, self.lay.width))
+        self.seg_chunks = seg_chunks    # initial budget; adapted per segment
+        self._init = jax.jit(_build_init(self.caps, self.A, self.lay.width))
+        # The carry's buffers are donated: each segment updates the search
+        # state in place in HBM; the host only syncs on the `done` scalar.
+        self._segment = jax.jit(
+            _build_segment(config, self.caps, self.A, self.lay.width),
+            donate_argnums=(0,))
 
     def check(self, init_override: interp.PyState | None = None
               ) -> EngineResult:
@@ -287,23 +352,42 @@ class DeviceEngine:
                 jnp.bool_(interp.constraint_ok(init_py, bounds)))
         if self.device is not None:
             args = jax.device_put(args, self.device)
-        out = self._search(*args)
-        # One blocking transfer for the scalars/small arrays.
+        carry = self._init(*args)
+        # Segment loop: each dispatch runs <= budget chunk expansions on
+        # device, then the host syncs on one scalar.  Buffers are donated, so
+        # the search state never moves.  The budget is retuned each dispatch
+        # toward SEG_TARGET_S seconds (the first, compile-carrying dispatch
+        # is excluded from the timing signal).
+        budget = max(1, self.seg_chunks)    # 0/negative would spin forever
+        first = True
+        while True:
+            t_seg = time.monotonic()
+            carry, done = self._segment(carry, jnp.int32(budget))
+            if bool(done):
+                break
+            dt = time.monotonic() - t_seg
+            if not first and dt > 0.05:
+                scale = min(2.0, max(0.25, self.SEG_TARGET_S / dt))
+                budget = int(min(self.SEG_MAX,
+                                 max(self.SEG_MIN, budget * scale)))
+                self.seg_chunks = budget    # warm check() calls start tuned
+            first = False
+        out = {"store": carry[0], "parent": carry[1], "lane": carry[2],
+               "n_states": carry[6], "viol_g": carry[9], "viol_i": carry[10],
+               "n_transitions": carry[11], "coverage": carry[12],
+               "fail": carry[13], "levels": carry[14], "n_levels": carry[15]}
         n_states = int(out["n_states"])
-        fail = bool(out["fail"])
+        fail = int(out["fail"])
         if fail:
             raise RuntimeError(
-                "device search aborted: store/level/probe capacity exceeded "
-                f"(caps={self.caps}) or state-width overflow — grow "
-                "Capacities and rerun")
+                f"device search aborted: {decode_fail(fail)} "
+                f"(caps={self.caps}) — grow Capacities and rerun")
         viol_g = int(out["viol_g"])
         n_levels = int(out["n_levels"])
+        # The partially-explored violating level is never recorded (the
+        # level window only advances on completed levels), matching refbfs.
         levels_arr = [1] + [int(x) for x in
                             np.asarray(out["levels"][:n_levels]) if int(x) > 0]
-        if viol_g >= 0 and len(levels_arr) > 1:
-            # refbfs never records the partially-explored violating level;
-            # drop it so violation-run diameters agree across all checkers.
-            levels_arr = levels_arr[:-1]
         cov_arr = np.asarray(out["coverage"])
         coverage: Counter = Counter()
         for a, inst in enumerate(self.table):
