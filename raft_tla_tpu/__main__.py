@@ -1,0 +1,7 @@
+"""``python -m raft_tla_tpu`` — alias for ``raft_tla_tpu.check``."""
+
+import sys
+
+from raft_tla_tpu.check import main
+
+sys.exit(main())

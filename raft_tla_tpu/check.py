@@ -1,0 +1,179 @@
+"""The checker CLI — the L6 layer (SURVEY §1), ``python -m raft_tla_tpu.check``.
+
+Drives a full checking run from a stock TLC model config (the reference's
+``raft.cfg:1-15`` parses unchanged), mirroring the TLC invocation surface the
+reference relies on (``.vscode/settings.json:3-4``): spec + cfg in,
+pass/violation + trace out, per-action coverage (TLC's ``-coverage``), and
+exit codes distinguishing success, violation, and error (TLC's own
+convention: 0 ok, 12 safety violation).
+
+The model universe (``Server``/``Value``) comes from the cfg; the state
+constraint — which stock TLC leaves to the missing ``CONSTRAINT`` stanza
+(SURVEY §0 defect 2) — comes from ``--max-*`` flags.  ``--emit-tlc DIR``
+writes the matching ``MCraft.tla``/``MCraft.cfg`` pair so the identical
+bounded model can be run under stock TLC on a JVM host (oracle parity,
+SURVEY §4.3).
+
+Engines (``--engine``): ``device`` (default; full search resident on the
+accelerator), ``shard`` (multi-device mesh over ICI), ``host`` (per-chunk
+jit, host dedup), ``ref`` (pure-Python oracle BFS).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+EXIT_OK = 0
+EXIT_VIOLATION = 12      # TLC's exit code for safety-property violations
+EXIT_ERROR = 1
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m raft_tla_tpu.check",
+        description="TPU-native exhaustive checker for the Raft TLA+ spec")
+    p.add_argument("cfg", help="TLC model config (e.g. the reference "
+                               "raft.cfg); binds Server/Value/INVARIANT")
+    p.add_argument("--spec", default="full",
+                   choices=("full", "election", "replication"),
+                   help="Next-disjunct subset (default: full raft.tla:454-465)")
+    p.add_argument("--engine", default="device",
+                   choices=("device", "shard", "host", "ref"))
+    p.add_argument("--max-term", type=int, default=3,
+                   help="CONSTRAINT: currentTerm[i] <= N (default 3)")
+    p.add_argument("--max-log", type=int, default=2,
+                   help="CONSTRAINT: Len(log[i]) <= N (default 2)")
+    p.add_argument("--max-msgs", type=int, default=4,
+                   help="CONSTRAINT: Cardinality(DOMAIN messages) <= N")
+    p.add_argument("--max-dup", type=int, default=1,
+                   help="CONSTRAINT: messages[m] <= N")
+    p.add_argument("--chunk", type=int, default=1024,
+                   help="frontier states expanded per device step")
+    p.add_argument("--cap", type=int, default=1 << 20,
+                   help="state-store capacity (device/shard engines)")
+    p.add_argument("--levels", type=int, default=256,
+                   help="max BFS depth (device/shard engines)")
+    p.add_argument("--devices", type=int, default=None,
+                   help="mesh size for --engine shard (default: all)")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (virtual devices for shard)")
+    p.add_argument("--emit-tlc", metavar="DIR",
+                   help="also write MCraft.tla/MCraft.cfg for a stock-TLC "
+                        "parity run, then continue")
+    p.add_argument("--no-trace", action="store_true",
+                   help="suppress the counterexample trace on violation")
+    p.add_argument("--coverage", action="store_true",
+                   help="print per-action coverage (TLC -coverage analog)")
+    return p
+
+
+def _resolve_config(args):
+    from raft_tla_tpu.config import Bounds, CheckConfig
+    from raft_tla_tpu.models import invariants as inv_mod
+    from raft_tla_tpu.utils.cfgparse import load_cfg
+
+    cfg = load_cfg(args.cfg)
+    if cfg.specification not in (None, "Spec"):
+        raise ValueError(
+            f"unsupported SPECIFICATION {cfg.specification!r}: the compiled "
+            "model implements Spec == Init /\\ [][Next]_vars (raft.tla:469)")
+    unknown = [nm for nm in cfg.invariants if nm not in inv_mod.REGISTRY]
+    if unknown:
+        raise ValueError(
+            f"unknown invariant(s) {unknown}; registry: "
+            f"{sorted(inv_mod.REGISTRY)}")
+    bounds = Bounds(
+        n_servers=len(cfg.server_names()),
+        n_values=len(cfg.value_names()),
+        max_term=args.max_term, max_log=args.max_log,
+        max_msgs=args.max_msgs, max_dup=args.max_dup)
+    return CheckConfig(bounds=bounds, spec=args.spec,
+                       invariants=tuple(cfg.invariants), chunk=args.chunk)
+
+
+def _run(args, config):
+    if args.cpu:
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            if args.devices:
+                jax.config.update("jax_num_cpu_devices", args.devices)
+        except RuntimeError:
+            # Backends already initialized (e.g. embedded in a process that
+            # ran a jax op); honoring --cpu is impossible now — say so
+            # rather than silently running on the accelerator.
+            if jax.default_backend() != "cpu":
+                print("Warning: --cpu requested but JAX backends are "
+                      f"already initialized on {jax.default_backend()!r}; "
+                      "proceeding there", file=sys.stderr)
+    if args.engine == "ref":
+        from raft_tla_tpu.models import refbfs
+        return refbfs.check(config)
+    if args.engine == "host":
+        from raft_tla_tpu import engine
+        return engine.check(config)
+    if args.engine == "shard":
+        from raft_tla_tpu.parallel.shard_engine import (
+            ShardCapacities, ShardEngine, make_mesh)
+        eng = ShardEngine(config, make_mesh(args.devices),
+                          ShardCapacities(n_states=args.cap,
+                                          levels=args.levels))
+        return eng.check()
+    from raft_tla_tpu.device_engine import Capacities, DeviceEngine
+    eng = DeviceEngine(config, Capacities(n_states=args.cap,
+                                          levels=args.levels))
+    return eng.check()
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    try:
+        config = _resolve_config(args)
+    except (OSError, ValueError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return EXIT_ERROR
+
+    b = config.bounds
+    print(f"raft_tla_tpu {__import__('raft_tla_tpu').__version__} — "
+          f"exhaustive check of Spec (raft.tla:469), subset: {args.spec}")
+    print(f"Universe: {b.n_servers} servers, {b.n_values} values "
+          f"(from {args.cfg})")
+    print(f"Constraint: MaxTerm={b.max_term} MaxLogLen={b.max_log} "
+          f"MaxMsgs={b.max_msgs} MaxDup={b.max_dup}")
+    print(f"Invariants: {', '.join(config.invariants) or '(none)'}")
+
+    if args.emit_tlc:
+        from raft_tla_tpu.models import tla_export
+        tla, cfgp = tla_export.export(args.emit_tlc, b, config.invariants)
+        print(f"TLC parity artifacts: {tla}, {cfgp}")
+
+    t0 = time.monotonic()
+    try:
+        result = _run(args, config)
+    except Exception as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return EXIT_ERROR
+    wall = time.monotonic() - t0
+
+    print(f"{result.n_states} distinct states found, diameter "
+          f"{result.diameter}, {result.n_transitions} transitions, "
+          f"{wall:.2f}s ({result.n_states / max(wall, 1e-9):,.0f} states/s).")
+    if args.coverage:
+        for fam, cnt in sorted(result.coverage.items()):
+            print(f"  {fam}: {cnt} new states")
+
+    if result.violation is None:
+        print("Model checking completed. No error has been found.")
+        return EXIT_OK
+    if args.no_trace:
+        print(f"Error: Invariant {result.violation.invariant} is violated.")
+    else:
+        from raft_tla_tpu.utils.render import render_trace
+        print(render_trace(result.violation, b))
+    return EXIT_VIOLATION
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,7 +54,7 @@ import dataclasses
 import functools
 import time
 from collections import Counter
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -153,11 +153,36 @@ def decode_fail(fail_bits: int) -> str:
                      if fail_bits & bit) or "unknown"
 
 
-def _carry_done(carry):
+class Carry(NamedTuple):
+    """The segment carry: the entire search state, resident in HBM.
+
+    A NamedTuple is a pytree, so it threads through ``lax.while_loop`` and
+    ``donate_argnums`` unchanged while keeping every access self-describing.
+    """
+
+    store: jax.Array      # [Ncap, W] every discovered state, discovery order
+    parent: jax.Array     # [Ncap] parent row (trace links)
+    lane: jax.Array       # [Ncap] action lane that produced the row
+    conflag: jax.Array    # [Ncap] state satisfies the CONSTRAINT -> expand
+    tbl_hi: jax.Array     # [Tcap] fingerprint table, hi words
+    tbl_lo: jax.Array     # [Tcap] fingerprint table, lo words
+    n_states: jax.Array   # rows used
+    lvl_start: jax.Array  # current BFS level window [lvl_start, lvl_end)
+    lvl_end: jax.Array
+    viol_g: jax.Array     # first violating row, -1 if none
+    viol_i: jax.Array     # index into config.invariants
+    n_trans: jax.Array    # enabled (state, action) pairs seen
+    cov: jax.Array        # [A] per-lane new-state counts
+    fail: jax.Array       # FAIL_* bitmask
+    levels: jax.Array     # [Lcap] per-level new-state counts
+    lvl: jax.Array        # current level number
+    c: jax.Array          # chunk cursor within the current level
+
+
+def _carry_done(carry: Carry):
     """Search-complete predicate over the segment carry."""
-    lvl_start, lvl_end, viol_g, fail = (carry[7], carry[8], carry[9],
-                                        carry[13])
-    return (lvl_end <= lvl_start) | (viol_g >= 0) | (fail != 0)
+    return ((carry.lvl_end <= carry.lvl_start) | (carry.viol_g >= 0)
+            | (carry.fail != 0))
 
 
 def _build_segment(config: CheckConfig, caps: Capacities, A: int, W: int):
@@ -174,7 +199,7 @@ def _build_segment(config: CheckConfig, caps: Capacities, A: int, W: int):
     Ncap, Lcap, Tcap = caps.n_states, caps.levels, caps.table
     BIG = jnp.int32(np.iinfo(np.int32).max)
 
-    def chunk_body(carry):
+    def chunk_body(carry: Carry) -> Carry:
         (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
          lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
          levels, lvl, c) = carry
@@ -225,22 +250,21 @@ def _build_segment(config: CheckConfig, caps: Capacities, A: int, W: int):
             ~out["inv_ok"].reshape(B * A, n_inv)
             [jnp.minimum(first, B * A - 1)]) if n_inv else jnp.int32(0)
         viol_i = jnp.where(new_viol, bad_inv, viol_i)
-        return (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
-                lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
-                levels, lvl, c + 1)
+        return Carry(store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+                     lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
+                     levels, lvl, c + 1)
 
     def outer_body(sc):
         """Run chunks until the level is exhausted or the budget runs out,
         then (maybe) advance the level window — scalar selects only, so the
         big buffers are never threaded through a conditional."""
         steps, carry = sc
-        n_act = carry[8] - carry[7]
-        n_chunks = (n_act + B - 1) // B
+        n_chunks = (carry.lvl_end - carry.lvl_start + B - 1) // B
 
         def ccond(cc):
             s, inner = cc
-            return ((inner[16] < n_chunks) & (inner[9] < 0) &
-                    (inner[13] == 0) & (s < budget))
+            return ((inner.c < n_chunks) & (inner.viol_g < 0) &
+                    (inner.fail == 0) & (s < budget))
 
         def cbody(cc):
             s, inner = cc
@@ -259,9 +283,9 @@ def _build_segment(config: CheckConfig, caps: Capacities, A: int, W: int):
         lvl_end = jnp.where(adv, n_states, lvl_end)
         lvl = jnp.where(adv, lvl + 1, lvl)
         c = jnp.where(adv, 0, c)
-        return steps, (store, parent, lane, conflag, tbl_hi, tbl_lo,
-                       n_states, lvl_start, lvl_end, viol_g, viol_i,
-                       n_trans, cov, fail, levels, lvl, c)
+        return steps, Carry(store, parent, lane, conflag, tbl_hi, tbl_lo,
+                            n_states, lvl_start, lvl_end, viol_g, viol_i,
+                            n_trans, cov, fail, levels, lvl, c)
 
     def outer_cond(sc):
         steps, carry = sc
@@ -292,11 +316,11 @@ def _build_init(caps: Capacities, A: int, W: int):
         tbl_lo = jnp.full((Tcap,), _EMPTY, U32).at[
             (init_key_lo & jnp.uint32(Tcap - 1)).astype(I32)].set(init_key_lo)
         levels = jnp.zeros((Lcap,), I32)
-        return (store, parent, lane, conflag, tbl_hi, tbl_lo,
-                jnp.int32(1), jnp.int32(0), jnp.int32(1),
-                jnp.int32(-1), jnp.int32(0), jnp.int32(0),
-                jnp.zeros((A,), I32), jnp.int32(0),
-                levels, jnp.int32(1), jnp.int32(0))
+        return Carry(store, parent, lane, conflag, tbl_hi, tbl_lo,
+                     jnp.int32(1), jnp.int32(0), jnp.int32(1),
+                     jnp.int32(-1), jnp.int32(0), jnp.int32(0),
+                     jnp.zeros((A,), I32), jnp.int32(0),
+                     levels, jnp.int32(1), jnp.int32(0))
 
     return init
 
@@ -372,23 +396,24 @@ class DeviceEngine:
                                  max(self.SEG_MIN, budget * scale)))
                 self.seg_chunks = budget    # warm check() calls start tuned
             first = False
-        out = {"store": carry[0], "parent": carry[1], "lane": carry[2],
-               "n_states": carry[6], "viol_g": carry[9], "viol_i": carry[10],
-               "n_transitions": carry[11], "coverage": carry[12],
-               "fail": carry[13], "levels": carry[14], "n_levels": carry[15]}
-        n_states = int(out["n_states"])
-        fail = int(out["fail"])
+        # One batched transfer for all the small outputs; the wide arrays
+        # (store, parent, lane) stay on device unless a trace is needed.
+        (n_states, viol_g, viol_i, n_trans, fail, n_levels, levels_dev,
+         cov_arr) = jax.device_get((
+             carry.n_states, carry.viol_g, carry.viol_i, carry.n_trans,
+             carry.fail, carry.lvl, carry.levels, carry.cov))
+        n_states, viol_g, fail = int(n_states), int(viol_g), int(fail)
         if fail:
             raise RuntimeError(
                 f"device search aborted: {decode_fail(fail)} "
                 f"(caps={self.caps}) — grow Capacities and rerun")
-        viol_g = int(out["viol_g"])
-        n_levels = int(out["n_levels"])
+        out = {"store": carry.store, "parent": carry.parent,
+               "lane": carry.lane, "viol_i": viol_i,
+               "n_transitions": n_trans}
         # The partially-explored violating level is never recorded (the
         # level window only advances on completed levels), matching refbfs.
-        levels_arr = [1] + [int(x) for x in
-                            np.asarray(out["levels"][:n_levels]) if int(x) > 0]
-        cov_arr = np.asarray(out["coverage"])
+        levels_arr = [1] + [int(x) for x in levels_dev[:int(n_levels)]
+                            if int(x) > 0]
         coverage: Counter = Counter()
         for a, inst in enumerate(self.table):
             if cov_arr[a]:

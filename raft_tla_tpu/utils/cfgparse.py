@@ -54,6 +54,7 @@ class TLCConfig:
     # Name -> python value: list[str] for set bindings, str for model values.
     constants: dict = dataclasses.field(default_factory=dict)
     symmetry: list[str] = dataclasses.field(default_factory=list)
+    view: str | None = None
 
     def server_names(self) -> list[str]:
         v = self.constants.get("Server")
@@ -119,6 +120,8 @@ def parse_cfg(text: str) -> TLCConfig:
             cfg.constraints.extend(line.split())
         elif mode == "SYMMETRY":
             cfg.symmetry.extend(line.split())
+        elif mode == "VIEW":
+            cfg.view = line
         elif mode in ("CONSTANT", "CONSTANTS"):
             if "=" not in line:
                 raise ValueError(f"bad CONSTANTS binding: {raw!r}")

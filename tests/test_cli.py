@@ -1,0 +1,148 @@
+"""CLI (check.py), TLC export (models/tla_export.py), and trace rendering.
+
+The CLI is the checker's L6 layer (SURVEY §1): stock cfg in, TLC-style
+report out, TLC-compatible exit codes.  No JVM exists here, so the TLC
+artifacts are validated structurally and by cfgparse round-trip
+(tla_export module docstring).
+"""
+
+import io
+import re
+from contextlib import redirect_stdout
+
+import pytest
+
+from raft_tla_tpu import check as cli
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.models import refbfs, spec as S, tla_export
+from raft_tla_tpu.models import interp
+from raft_tla_tpu.ops import msgbits as mb
+from raft_tla_tpu.utils import render
+from raft_tla_tpu.utils.cfgparse import parse_cfg
+
+REF_CFG = "/root/reference/raft.cfg"
+
+
+def run_cli(*argv):
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        code = cli.main(list(argv))
+    return code, buf.getvalue()
+
+
+def test_cli_ref_engine_pass():
+    code, out = run_cli(REF_CFG, "--engine", "ref", "--spec", "election",
+                        "--max-term", "2", "--max-log", "0",
+                        "--max-msgs", "1", "--coverage")
+    assert code == cli.EXIT_OK
+    assert "No error has been found" in out
+    m = re.search(r"(\d+) distinct states found, diameter (\d+)", out)
+    assert m, out
+    # same numbers the engines' parity tests pin for this config
+    cc = CheckConfig(bounds=Bounds(n_servers=3, n_values=2, max_term=2,
+                                   max_log=0, max_msgs=1),
+                     spec="election", invariants=("NoTwoLeaders",))
+    ref = refbfs.check(cc)
+    assert (int(m.group(1)), int(m.group(2))) == (ref.n_states, ref.diameter)
+    assert "BecomeLeader" in out          # --coverage section
+
+
+def test_cli_device_engine_pass():
+    code, out = run_cli(REF_CFG, "--engine", "device", "--cpu",
+                        "--spec", "election", "--max-term", "2",
+                        "--max-log", "0", "--max-msgs", "1",
+                        "--cap", str(1 << 18), "--chunk", "256")
+    assert code == cli.EXIT_OK and "No error has been found" in out
+
+
+def test_cli_bad_cfg_and_bad_invariant(tmp_path):
+    code, _ = run_cli(str(tmp_path / "missing.cfg"))
+    assert code == cli.EXIT_ERROR
+    bad = tmp_path / "bad.cfg"
+    bad.write_text("SPECIFICATION Spec\nINVARIANT NoSuchThing\nCONSTANTS\n"
+                   "    Server = {s1}\n    Value = {v1}\n")
+    code, _ = run_cli(str(bad))
+    assert code == cli.EXIT_ERROR
+
+
+def test_cli_capacity_error_is_loud(tmp_path):
+    code, _ = run_cli(REF_CFG, "--engine", "device", "--cpu",
+                      "--spec", "election", "--max-term", "2",
+                      "--max-log", "0", "--max-msgs", "1",
+                      "--cap", "512", "--chunk", "64")
+    assert code == cli.EXIT_ERROR
+
+
+def bag(*ms):
+    return tuple(sorted((m, 1) for m in ms))
+
+
+@pytest.fixture(scope="module")
+def seeded_violation():
+    """The seeded NaiveNoTwoLeaders violation from the engine tests."""
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=4, max_dup=1)
+    cfg = CheckConfig(bounds=bounds, spec="election",
+                      invariants=("NaiveNoTwoLeaders",), chunk=256)
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3), votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100),
+        msgs=bag(mb.rv_response(3, 1, 1, 2)))
+    res = refbfs.check(cfg, init_override=start)
+    assert res.violation is not None
+    return res.violation, bounds
+
+
+def test_render_trace_tlc_style(seeded_violation):
+    violation, bounds = seeded_violation
+    text = render.render_trace(violation, bounds)
+    assert "Error: Invariant NaiveNoTwoLeaders is violated." in text
+    assert "State 1: <Initial predicate>" in text
+    # every subsequent step names its action
+    n_states = len(violation.trace)
+    for k in range(2, n_states + 1):
+        assert f"State {k}: <" in text
+    # TLA-style variable conjunctions with reference variable names
+    for var in ("messages", "currentTerm", "state", "votedFor", "log",
+                "commitIndex", "votesResponded", "votesGranted",
+                "nextIndex", "matchIndex"):
+        assert f"/\\ {var} = " in text
+    # the final state really shows two leaders
+    assert text.count("Leader") >= 2
+
+
+def test_render_messages_have_schema_fields(seeded_violation):
+    violation, bounds = seeded_violation
+    text = render.render_trace(violation, bounds)
+    assert "mtype |-> RequestVoteResponse" in text
+    assert "mvoteGranted |-> TRUE" in text
+
+
+def test_tla_export_structure(tmp_path):
+    bounds = Bounds(n_servers=3, n_values=2, max_term=3, max_log=2,
+                    max_msgs=4, max_dup=1)
+    tla, cfgp = tla_export.export(str(tmp_path), bounds,
+                                  ("NoTwoLeaders", "LogMatching"))
+    mod = open(tla).read()
+    assert mod.startswith("---------------------------- MODULE MCraft ")
+    assert "EXTENDS raft" in mod
+    assert "NoTwoLeaders ==" in mod and "LogMatching ==" in mod
+    assert "currentTerm[i] <= 3" in mod and "Len(log[i]) <= 2" in mod
+    assert "Cardinality(DOMAIN messages) <= 4" in mod
+    assert "ParityView" in mod and "StripMsg" in mod
+    assert mod.rstrip().endswith("=" * 77)
+
+    # cfg round-trips through our own byte-compatible parser
+    cfg = parse_cfg(open(cfgp).read())
+    assert cfg.specification == "Spec"
+    assert cfg.invariants == ["NoTwoLeaders", "LogMatching"]
+    assert cfg.constraints == ["StateConstraint"]
+    assert cfg.server_names() == ["s1", "s2", "s3"]
+    assert cfg.value_names() == ["v1", "v2"]
+    assert cfg.constants["Follower"] == "Follower"
+
+
+def test_tla_export_unknown_invariant(tmp_path):
+    with pytest.raises(ValueError, match="no TLA\\+ export"):
+        tla_export.emit_module(Bounds(), ("NotAnInvariant",))
