@@ -1,0 +1,147 @@
+// Host-side native runtime for the TPU checker (SURVEY §2.8).
+//
+// Plays the role TLC's disk-backed `states/` directory plays for the
+// reference workflow (reference .gitignore:2): an append-only store of every
+// discovered state, addressed by discovery index, living in host RAM rather
+// than HBM.  The device keeps only the active BFS levels (a ring) plus the
+// fingerprint table; everything older pages out here through these calls.
+// Parent/lane link arrays (TLC's predecessor links for counterexample
+// traces) ride along, so trace reconstruction never touches the device.
+//
+// Also hosts the bit-identical FP64 fingerprint (two-lane multilinear +
+// murmur3 fmix32, constants supplied by the Python side from
+// ops/fingerprint.lane_constants): sharding routes states by fingerprint, so
+// host and device hashes MUST agree bit-for-bit (ops/fingerprint.py
+// docstring).  Exposed C ABI only; bound via ctypes (no pybind11 in the
+// image).
+//
+// Memory layout: fixed-size blocks (BLOCK_ROWS rows each) held in a vector
+// of unique_ptr — append never reallocates or copies existing rows, so read
+// pointers stay valid across appends and capacity grows to host RAM.
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <vector>
+
+namespace {
+
+constexpr int64_t BLOCK_ROWS = 1 << 16;
+
+struct Store {
+    int32_t width;                // int32 words per state row
+    int64_t n_rows = 0;
+    int64_t n_links = 0;
+    std::vector<std::unique_ptr<int32_t[]>> blocks;        // state rows
+    std::vector<std::unique_ptr<int32_t[]>> link_blocks;   // (parent, lane)
+
+    explicit Store(int32_t w) : width(w) {}
+
+    int32_t* row_ptr(int64_t r) {
+        return blocks[r / BLOCK_ROWS].get() + (r % BLOCK_ROWS) * width;
+    }
+    int32_t* link_ptr(int64_t r) {
+        return link_blocks[r / BLOCK_ROWS].get() + (r % BLOCK_ROWS) * 2;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+Store* store_create(int32_t width) { return new Store(width); }
+
+void store_destroy(Store* s) { delete s; }
+
+int64_t store_size(const Store* s) { return s->n_rows; }
+
+// Append n rows of s->width int32s; returns the new row count.
+int64_t store_append(Store* s, const int32_t* rows, int64_t n) {
+    for (int64_t k = 0; k < n; ++k) {
+        if (s->n_rows / BLOCK_ROWS >= (int64_t)s->blocks.size())
+            s->blocks.emplace_back(new int32_t[BLOCK_ROWS * s->width]);
+        std::memcpy(s->row_ptr(s->n_rows), rows + k * s->width,
+                    sizeof(int32_t) * s->width);
+        ++s->n_rows;
+    }
+    return s->n_rows;
+}
+
+void store_read(Store* s, int64_t start, int64_t n, int32_t* out) {
+    for (int64_t k = 0; k < n; ++k)
+        std::memcpy(out + k * s->width, s->row_ptr(start + k),
+                    sizeof(int32_t) * s->width);
+}
+
+// Trace links: (parent discovery index, action lane) per row.
+int64_t store_append_links(Store* s, const int32_t* parent,
+                           const int32_t* lane, int64_t n) {
+    for (int64_t k = 0; k < n; ++k) {
+        if (s->n_links / BLOCK_ROWS >= (int64_t)s->link_blocks.size())
+            s->link_blocks.emplace_back(new int32_t[BLOCK_ROWS * 2]);
+        int32_t* p = s->link_ptr(s->n_links);
+        p[0] = parent[k];
+        p[1] = lane[k];
+        ++s->n_links;
+    }
+    return s->n_links;
+}
+
+void store_read_links(Store* s, int64_t start, int64_t n,
+                      int32_t* parent_out, int32_t* lane_out) {
+    for (int64_t k = 0; k < n; ++k) {
+        const int32_t* p = s->link_ptr(start + k);
+        parent_out[k] = p[0];
+        lane_out[k] = p[1];
+    }
+}
+
+// Walk a parent chain backwards from `from_row` to the root; returns chain
+// length, writing discovery indices root-first into out (capacity out_cap).
+int64_t store_trace_chain(Store* s, int64_t from_row, int64_t* out,
+                          int64_t out_cap) {
+    int64_t len = 0;
+    for (int64_t cur = from_row; cur >= 0; ++len) {
+        if (len >= out_cap) return -1;           // caller's buffer too small
+        out[len] = cur;
+        cur = s->link_ptr(cur)[0];
+    }
+    // reverse to root-first order
+    for (int64_t a = 0, b = len - 1; a < b; ++a, --b) {
+        int64_t t = out[a];
+        out[a] = out[b];
+        out[b] = t;
+    }
+    return len;
+}
+
+// Bit-identical twin of ops/fingerprint.fingerprint (two-lane multilinear
+// multiply-sum mod 2^32 + murmur3 fmix32).  c1/c2 are the lane_constants
+// rows; seeds are _LANE_SEEDS.
+static inline uint32_t fmix32(uint32_t h) {
+    h ^= h >> 16;
+    h *= 0x85EBCA6Bu;
+    h ^= h >> 13;
+    h *= 0xC2B2AE35u;
+    h ^= h >> 16;
+    return h;
+}
+
+void fingerprint_rows(const int32_t* rows, int64_t n, int32_t width,
+                      const uint32_t* c1, const uint32_t* c2,
+                      uint32_t seed1, uint32_t seed2,
+                      uint32_t* hi_out, uint32_t* lo_out) {
+    for (int64_t k = 0; k < n; ++k) {
+        const int32_t* row = rows + k * width;
+        uint32_t s1 = 0, s2 = 0;
+        for (int32_t w = 0; w < width; ++w) {
+            uint32_t v = (uint32_t)row[w];
+            s1 += v * c1[w];
+            s2 += v * c2[w];
+        }
+        hi_out[k] = fmix32(s1 + seed1);
+        lo_out[k] = fmix32(s2 + seed2);
+    }
+}
+
+}  // extern "C"

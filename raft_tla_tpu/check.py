@@ -40,7 +40,11 @@ def build_argparser() -> argparse.ArgumentParser:
                    choices=("full", "election", "replication"),
                    help="Next-disjunct subset (default: full raft.tla:454-465)")
     p.add_argument("--engine", default="device",
-                   choices=("device", "shard", "host", "ref"))
+                   choices=("device", "paged", "shard", "host", "ref"),
+                   help="device: search resident in HBM; paged: HBM ring + "
+                        "native host store (capacity bounded by host RAM); "
+                        "shard: multi-chip mesh; host: per-chunk jit; "
+                        "ref: pure-Python oracle")
     p.add_argument("--max-term", type=int, default=3,
                    help="CONSTRAINT: currentTerm[i] <= N (default 3)")
     p.add_argument("--max-log", type=int, default=2,
@@ -62,6 +66,13 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--emit-tlc", metavar="DIR",
                    help="also write MCraft.tla/MCraft.cfg for a stock-TLC "
                         "parity run, then continue")
+    p.add_argument("--checkpoint", metavar="PATH",
+                   help="periodically snapshot the search (device engine); "
+                        "resume later with --resume")
+    p.add_argument("--checkpoint-every", type=float, default=600.0,
+                   metavar="SECONDS")
+    p.add_argument("--resume", metavar="PATH",
+                   help="resume a --checkpoint snapshot (device engine)")
     p.add_argument("--no-trace", action="store_true",
                    help="suppress the counterexample trace on violation")
     p.add_argument("--coverage", action="store_true",
@@ -84,6 +95,23 @@ def _resolve_config(args):
         raise ValueError(
             f"unknown invariant(s) {unknown}; registry: "
             f"{sorted(inv_mod.REGISTRY)}")
+    if cfg.properties:
+        raise ValueError(
+            f"PROPERTY {cfg.properties} not supported: liveness checking is "
+            "not implemented; only INVARIANT (safety) is")
+    if cfg.symmetry:
+        raise ValueError(f"SYMMETRY {cfg.symmetry} not supported")
+    # Our own --emit-tlc artifacts declare the constraint/view this checker
+    # builds in; anything else would be silently unchecked.
+    if [c for c in cfg.constraints if c != "StateConstraint"]:
+        raise ValueError(
+            f"CONSTRAINT {cfg.constraints} not supported: the state "
+            "constraint is the built-in bound, set via --max-* flags "
+            "(emitted to TLC as 'StateConstraint')")
+    if cfg.view not in (None, "ParityView"):
+        raise ValueError(
+            f"VIEW {cfg.view} not supported: states are always "
+            "fingerprinted under the built-in history-free ParityView")
     bounds = Bounds(
         n_servers=len(cfg.server_names()),
         n_values=len(cfg.value_names()),
@@ -114,6 +142,16 @@ def _run(args, config):
     if args.engine == "host":
         from raft_tla_tpu import engine
         return engine.check(config)
+    if args.engine == "paged":
+        from raft_tla_tpu.models import spec as S
+        from raft_tla_tpu.paged_engine import PagedCapacities, PagedEngine
+        A = len(S.action_table(config.bounds, config.spec))
+        table = 1 << max(1, (2 * args.cap - 1).bit_length())
+        ring = 1 << min(22, max(12, (args.cap // 4).bit_length()))
+        eng = PagedEngine(config, PagedCapacities(
+            ring=max(ring, 1 << (2 * args.chunk * A - 1).bit_length()),
+            table=table, levels=args.levels))
+        return eng.check()
     if args.engine == "shard":
         from raft_tla_tpu.parallel.shard_engine import (
             ShardCapacities, ShardEngine, make_mesh)
@@ -124,11 +162,18 @@ def _run(args, config):
     from raft_tla_tpu.device_engine import Capacities, DeviceEngine
     eng = DeviceEngine(config, Capacities(n_states=args.cap,
                                           levels=args.levels))
-    return eng.check()
+    return eng.check(checkpoint=args.checkpoint,
+                     checkpoint_every_s=args.checkpoint_every,
+                     resume=args.resume)
 
 
 def main(argv=None) -> int:
-    args = build_argparser().parse_args(argv)
+    p = build_argparser()
+    args = p.parse_args(argv)
+    if (args.checkpoint or args.resume) and args.engine != "device":
+        p.error(f"--checkpoint/--resume require --engine device "
+                f"(got {args.engine}); other engines would silently "
+                "ignore them")
     try:
         config = _resolve_config(args)
     except (OSError, ValueError) as e:
@@ -146,7 +191,12 @@ def main(argv=None) -> int:
 
     if args.emit_tlc:
         from raft_tla_tpu.models import tla_export
-        tla, cfgp = tla_export.export(args.emit_tlc, b, config.invariants)
+        try:
+            tla, cfgp = tla_export.export(args.emit_tlc, b,
+                                          config.invariants)
+        except (OSError, ValueError) as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return EXIT_ERROR
         print(f"TLC parity artifacts: {tla}, {cfgp}")
 
     t0 = time.monotonic()

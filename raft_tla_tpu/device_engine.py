@@ -52,6 +52,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import hashlib
+import os
 import time
 from collections import Counter
 from typing import NamedTuple, Optional
@@ -139,12 +141,14 @@ FAIL_WIDTH = 1      # a successor exceeded a tensor-encoding capacity
 FAIL_PROBE = 2      # linear probe exceeded _MAX_PROBE (table too full)
 FAIL_STORE = 4      # more distinct states than Capacities.n_states
 FAIL_LEVEL = 8      # BFS deeper than Capacities.levels
+FAIL_RING = 16      # paged engine: live BFS window outgrew the HBM ring
 
 _FAIL_TEXT = {
     FAIL_WIDTH: "state-width overflow (encoding capacity exceeded)",
     FAIL_PROBE: "fingerprint-table probe overflow (table too full)",
     FAIL_STORE: "state-store capacity exceeded",
     FAIL_LEVEL: "BFS level capacity exceeded",
+    FAIL_RING: "live BFS window exceeded the HBM ring",
 }
 
 
@@ -354,8 +358,47 @@ class DeviceEngine:
             _build_segment(config, self.caps, self.A, self.lay.width),
             donate_argnums=(0,))
 
-    def check(self, init_override: interp.PyState | None = None
-              ) -> EngineResult:
+    # -- checkpoint / resume (SURVEY §5: TLC's states/ + -recover analog) ---
+    # A checkpoint is the full carry — the search is a pure function of it,
+    # so resume is exact: same discovery order, counts, traces.
+
+    def _ckpt_digest(self) -> int:
+        """Pins model identity: explored states were constrained and
+        invariant-checked under exactly this config; resuming under any
+        other would be silently unsound."""
+        key = repr((self.config.bounds, self.config.spec,
+                    self.config.invariants, self.config.chunk,
+                    self.caps)).encode()
+        return int.from_bytes(hashlib.sha256(key).digest()[:8], "big")
+
+    def save_checkpoint(self, path: str, carry: Carry) -> None:
+        """Snapshot the carry to ``path`` (.npz), atomically."""
+        host = jax.device_get(carry)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:      # file handle: savez adds no suffix
+            np.savez(f, **{f"c{i}": np.asarray(x)
+                           for i, x in enumerate(host)},
+                     config_digest=np.uint64(self._ckpt_digest()),
+                     width=np.int64(self.lay.width))
+        os.replace(tmp, path)
+
+    def load_checkpoint(self, path: str) -> Carry:
+        """Load a carry saved by :meth:`save_checkpoint`; the checkpoint's
+        full model identity (bounds, spec subset, invariants, chunk,
+        capacities) must match this engine's."""
+        with np.load(path) as z:
+            if int(z["config_digest"]) != self._ckpt_digest():
+                raise ValueError(
+                    "checkpoint was written under a different model config "
+                    "(bounds/spec/invariants/chunk/capacities digest "
+                    "mismatch); resuming it here would be unsound")
+            arrs = [z[f"c{i}"] for i in range(len(Carry._fields))]
+        return Carry(*(jnp.asarray(a) for a in arrs))
+
+    def check(self, init_override: interp.PyState | None = None,
+              checkpoint: str | None = None,
+              checkpoint_every_s: float = 600.0,
+              resume: str | None = None) -> EngineResult:
         t0 = time.monotonic()
         bounds = self.bounds
         init_py = init_override if init_override is not None \
@@ -376,7 +419,8 @@ class DeviceEngine:
                 jnp.bool_(interp.constraint_ok(init_py, bounds)))
         if self.device is not None:
             args = jax.device_put(args, self.device)
-        carry = self._init(*args)
+        carry = self.load_checkpoint(resume) if resume \
+            else self._init(*args)
         # Segment loop: each dispatch runs <= budget chunk expansions on
         # device, then the host syncs on one scalar.  Buffers are donated, so
         # the search state never moves.  The budget is retuned each dispatch
@@ -384,11 +428,16 @@ class DeviceEngine:
         # is excluded from the timing signal).
         budget = max(1, self.seg_chunks)    # 0/negative would spin forever
         first = True
+        last_ckpt = time.monotonic()
         while True:
             t_seg = time.monotonic()
             carry, done = self._segment(carry, jnp.int32(budget))
             if bool(done):
                 break
+            if checkpoint and (time.monotonic() - last_ckpt
+                               >= checkpoint_every_s):
+                self.save_checkpoint(checkpoint, carry)
+                last_ckpt = time.monotonic()
             dt = time.monotonic() - t_seg
             if not first and dt > 0.05:
                 scale = min(2.0, max(0.25, self.SEG_TARGET_S / dt))

@@ -1,0 +1,342 @@
+"""Host-paged BFS engine — HBM ring + native host store (SURVEY §2.8).
+
+The device-resident engine (device_engine.py) keeps every discovered state in
+HBM: at ~240 B/state plus the <2 GiB single-buffer limit, that caps a run at
+~8M states — far below the bounded full-``Next`` spaces (the 3-server/2-value
+model exceeds that by level 18).  This engine removes the ceiling the way TLC
+does with its disk-backed ``states/`` queue (reference ``.gitignore:2``):
+
+- **Only the active BFS window lives in HBM** — a ring of the current level
+  (being expanded) and the next (being appended).  A state's ring row is its
+  discovery index mod ``ring``; level-synchronous BFS guarantees the live
+  window ``[lvl_start, n_states)`` is contiguous, so ring reuse is safe while
+  the window fits (checked loudly: FAIL_RING).
+- **Every new state pages out to the C++ host store** (utils/native.py)
+  after each watchdog segment, with its (parent, lane) trace links — one
+  batched device→host transfer per segment, bucketed to limit recompiles.
+  Host RAM (then disk) is the capacity bound, not HBM.
+- **Only the fingerprint table scales with the full space** on device:
+  8 B/slot at load ≤ 0.5 → ~16 B/state, an order of magnitude less than
+  storing states.  ~64M states fit in ~1 GiB of table.
+- Violation traces reconstruct entirely host-side: ``store_trace_chain``
+  walks the native link log; the device is never consulted.
+
+Shares the fingerprint table protocol, failure bitmask, segment/watchdog
+machinery and Carry layout with device_engine.py; discovery order — and
+therefore counts, levels, coverage, and first-violation — is byte-identical
+to the oracle's, which the parity tests assert with rings small enough to
+wrap many times per run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import Counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import CheckConfig
+from raft_tla_tpu.device_engine import (
+    _EMPTY, _dedup_insert, Carry, FAIL_LEVEL, FAIL_PROBE,
+    FAIL_RING, FAIL_WIDTH, decode_fail, _carry_done)
+from raft_tla_tpu.engine import EngineResult, Violation
+from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
+from raft_tla_tpu.ops import fingerprint as fpr
+from raft_tla_tpu.ops import kernels
+from raft_tla_tpu.ops import state as st
+from raft_tla_tpu.utils import native
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedCapacities:
+    """Static shapes of one compiled paged search.
+
+    ``ring`` bounds the *live window* (current + next BFS level), not the
+    total space; ``table`` bounds total distinct states at ~2 slots/state.
+    """
+
+    ring: int = 1 << 20          # HBM rows for the active window
+    table: int = 1 << 24         # fingerprint slots (power of two)
+    levels: int = 1 << 10
+
+    def __post_init__(self):
+        if self.ring & (self.ring - 1) or self.table & (self.table - 1):
+            raise ValueError("ring and table must be powers of two")
+
+
+def _build_segment(config: CheckConfig, caps: PagedCapacities, A: int,
+                   W: int):
+    """Ring variant of device_engine._build_segment (same Carry, same loop
+    structure; store/parent/lane/conflag are rings indexed by discovery
+    index mod ``ring``)."""
+    B = config.chunk
+    n_inv = len(config.invariants)
+    step = kernels.build_step(config.bounds, config.spec,
+                              tuple(config.invariants))
+    Rcap, Lcap = caps.ring, caps.levels
+    rmask = Rcap - 1
+    BIG = jnp.int32(np.iinfo(np.int32).max)
+
+    def chunk_body(carry: Carry) -> Carry:
+        (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
+         levels, lvl, c) = carry
+        start = lvl_start + c * B
+        rows_g = start + jnp.arange(B, dtype=I32)
+        row_act = rows_g < lvl_end
+        ridx = rows_g & rmask
+        vecs = store[ridx]
+        out = step(vecs)
+        valid = out["valid"] & row_act[:, None] & conflag[ridx][:, None]
+        n_trans = n_trans + jnp.sum(valid.astype(I32))
+        fail = fail | jnp.any(valid & out["overflow"]) * FAIL_WIDTH
+
+        fhi = out["fp_hi"].reshape(-1)
+        flo = out["fp_lo"].reshape(-1)
+        fvalid = valid.reshape(-1)
+        tbl_hi, tbl_lo, is_new, pfail = _dedup_insert(
+            tbl_hi, tbl_lo, fhi, flo, fvalid)
+        fail = fail | pfail * FAIL_PROBE
+
+        # Append new states into the ring at (discovery index mod Rcap).
+        pos = n_states + jnp.cumsum(is_new.astype(I32)) - 1
+        n_new = jnp.sum(is_new.astype(I32))
+        # Live window must fit the ring: appending past lvl_start + Rcap
+        # would overwrite the frontier still being expanded.
+        fail = fail | (n_states + n_new - lvl_start > Rcap) * FAIL_RING
+        ok = is_new & (pos - lvl_start < Rcap)
+        sl = jnp.where(ok, pos & rmask, Rcap)
+        svecs = out["svecs"].reshape(B * A, W)
+        store = store.at[sl].set(svecs, mode="drop")
+        flat_b = jnp.arange(B * A, dtype=I32) // A
+        flat_a = jnp.arange(B * A, dtype=I32) % A
+        parent = parent.at[sl].set(start + flat_b, mode="drop")
+        lane = lane.at[sl].set(flat_a, mode="drop")
+        conflag = conflag.at[sl].set(out["con_ok"].reshape(-1), mode="drop")
+        cov = cov.at[jnp.where(is_new, flat_a, A)].add(1, mode="drop")
+        n_states = n_states + n_new
+
+        inv_bad = is_new & jnp.any(
+            ~out["inv_ok"].reshape(B * A, n_inv), axis=-1) if n_inv \
+            else jnp.zeros((B * A,), bool)
+        first = jnp.min(jnp.where(inv_bad, jnp.arange(B * A, dtype=I32), BIG))
+        has_viol = first < BIG
+        new_viol = has_viol & (viol_g < 0)
+        viol_g = jnp.where(new_viol, pos[jnp.minimum(first, B * A - 1)],
+                           viol_g)
+        bad_inv = jnp.argmax(
+            ~out["inv_ok"].reshape(B * A, n_inv)
+            [jnp.minimum(first, B * A - 1)]) if n_inv else jnp.int32(0)
+        viol_i = jnp.where(new_viol, bad_inv, viol_i)
+        return Carry(store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+                     lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
+                     levels, lvl, c + 1)
+
+    def outer_body(sc):
+        steps, carry = sc
+        n_chunks = (carry.lvl_end - carry.lvl_start + B - 1) // B
+
+        def ccond(cc):
+            s, inner = cc
+            return ((inner.c < n_chunks) & (inner.viol_g < 0) &
+                    (inner.fail == 0) & (s < budget) &
+                    (inner.n_states < pause))    # host must page out first
+
+        def cbody(cc):
+            s, inner = cc
+            return s + 1, chunk_body(inner)
+
+        steps, carry = jax.lax.while_loop(ccond, cbody, (steps, carry))
+        (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
+         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
+         levels, lvl, c) = carry
+        adv = (c >= n_chunks) & (viol_g < 0) & (fail == 0)
+        n_new = n_states - lvl_end
+        levels = levels.at[jnp.where(adv, jnp.minimum(lvl, Lcap - 1),
+                                     Lcap)].set(n_new, mode="drop")
+        fail = fail | (adv & (lvl >= Lcap - 1) & (n_new > 0)) * FAIL_LEVEL
+        lvl_start = jnp.where(adv, lvl_end, lvl_start)
+        lvl_end = jnp.where(adv, n_states, lvl_end)
+        lvl = jnp.where(adv, lvl + 1, lvl)
+        c = jnp.where(adv, 0, c)
+        return steps, Carry(store, parent, lane, conflag, tbl_hi, tbl_lo,
+                            n_states, lvl_start, lvl_end, viol_g, viol_i,
+                            n_trans, cov, fail, levels, lvl, c)
+
+    def outer_cond(sc):
+        steps, carry = sc
+        return (steps < budget) & ~_carry_done(carry)
+
+    def segment(carry, budget_, pause_at):
+        # ``pause_at``: also return control once n_states crosses this mark,
+        # so the host can page out before the ring laps itself.
+        nonlocal budget, pause
+        budget, pause = budget_, pause_at
+        _, carry = jax.lax.while_loop(
+            lambda sc: outer_cond(sc) & (sc[1].n_states < pause),
+            lambda sc: outer_body(sc), (jnp.int32(0), carry))
+        return carry, _carry_done(carry)
+
+    budget = pause = None
+    return segment
+
+
+def _build_init(caps: PagedCapacities, A: int, W: int):
+    Rcap, Lcap, Tcap = caps.ring, caps.levels, caps.table
+
+    def init(init_vec, init_key_hi, init_key_lo, init_con):
+        store = jnp.zeros((Rcap, W), I32).at[0].set(init_vec)
+        parent = jnp.full((Rcap,), -1, I32)
+        lane = jnp.full((Rcap,), -1, I32)
+        conflag = jnp.zeros((Rcap,), bool).at[0].set(init_con)
+        tbl_hi = jnp.full((Tcap,), _EMPTY, U32).at[
+            (init_key_lo & jnp.uint32(Tcap - 1)).astype(I32)].set(init_key_hi)
+        tbl_lo = jnp.full((Tcap,), _EMPTY, U32).at[
+            (init_key_lo & jnp.uint32(Tcap - 1)).astype(I32)].set(init_key_lo)
+        levels = jnp.zeros((Lcap,), I32)
+        return Carry(store, parent, lane, conflag, tbl_hi, tbl_lo,
+                     jnp.int32(1), jnp.int32(0), jnp.int32(1),
+                     jnp.int32(-1), jnp.int32(0), jnp.int32(0),
+                     jnp.zeros((A,), I32), jnp.int32(0),
+                     levels, jnp.int32(1), jnp.int32(0))
+
+    return init
+
+
+class PagedEngine:
+    """Exhaustive checker bounded by host RAM, not HBM."""
+
+    SEG_TARGET_S = 8.0
+    SEG_MIN, SEG_MAX = 16, 1 << 16
+
+    def __init__(self, config: CheckConfig, caps: PagedCapacities | None =
+                 None, seg_chunks: int = 64):
+        self.config = config
+        self.bounds = config.bounds
+        self.lay = st.Layout.of(self.bounds)
+        self.table = S.action_table(self.bounds, config.spec)
+        self.A = len(self.table)
+        self.caps = caps or PagedCapacities()
+        # One chunk appends up to chunk*A rows past the pause mark (the
+        # pause check runs between chunks); ring//2 headroom must absorb it
+        # so unpaged rows are never overwritten.
+        if self.caps.ring < 2 * config.chunk * self.A:
+            raise ValueError(
+                f"PagedCapacities.ring={self.caps.ring} must be >= "
+                f"2 * chunk * A = {2 * config.chunk * self.A}")
+        self.seg_chunks = seg_chunks
+        self._init = jax.jit(_build_init(self.caps, self.A, self.lay.width))
+        self._segment = jax.jit(
+            _build_segment(config, self.caps, self.A, self.lay.width),
+            donate_argnums=(0,))
+        self._gather = jax.jit(
+            lambda carry, ridx: (carry.store[ridx], carry.parent[ridx],
+                                 carry.lane[ridx]))
+
+    def _pageout(self, carry, host, paged: int, n_states: int) -> int:
+        """Copy rows [paged, n_states) from the device ring to the host
+        store.  Bucketed padding keeps the gather jit-cache small."""
+        while paged < n_states:
+            n = min(n_states - paged, self.caps.ring)
+            bucket = 1 << (max(n - 1, 0)).bit_length()
+            bucket = max(bucket, 1024)
+            gidx = paged + np.arange(bucket, dtype=np.int32)
+            gidx = np.minimum(gidx, n_states - 1)       # pad with last row
+            ridx = jnp.asarray(gidx & (self.caps.ring - 1))
+            rows, par, lan = jax.device_get(self._gather(carry, ridx))
+            host.append(rows[:n])
+            host.append_links(par[:n], lan[:n])
+            paged += n
+        return paged
+
+    def check(self, init_override: interp.PyState | None = None
+              ) -> EngineResult:
+        t0 = time.monotonic()
+        bounds = self.bounds
+        init_py = init_override if init_override is not None \
+            else interp.init_state(bounds)
+        init_vec = interp.to_vec(init_py, bounds)
+        consts = fpr.lane_constants(self.lay.width)
+        hi0, lo0 = fpr.fingerprint(init_vec.astype(np.int32), consts, np)
+
+        for nm in self.config.invariants:
+            if not inv_mod.py_invariant(nm)(init_py, bounds):
+                return EngineResult(
+                    n_states=1, diameter=0, n_transitions=0,
+                    coverage=Counter(),
+                    violation=Violation(nm, init_py, [(None, init_py)]),
+                    levels=[1], wall_s=time.monotonic() - t0)
+
+        host = native.make_store(self.lay.width)
+        carry = self._init(jnp.asarray(init_vec, I32), jnp.uint32(hi0),
+                           jnp.uint32(lo0),
+                           jnp.bool_(interp.constraint_ok(init_py, bounds)))
+        budget = max(1, self.seg_chunks)
+        paged = 0
+        first = True
+        while True:
+            # Pause the device loop before unpaged rows could be overwritten:
+            # rows < pause_at are safe while n_states - lvl_start <= ring.
+            pause_at = paged + self.caps.ring // 2
+            t_seg = time.monotonic()
+            carry, done = self._segment(carry, jnp.int32(budget),
+                                        jnp.int32(pause_at))
+            n_states = int(carry.n_states)
+            paged = self._pageout(carry, host, paged, n_states)
+            if bool(done):
+                break
+            dt = time.monotonic() - t_seg
+            if not first and dt > 0.05:
+                scale = min(2.0, max(0.25, self.SEG_TARGET_S / dt))
+                budget = int(min(self.SEG_MAX,
+                                 max(self.SEG_MIN, budget * scale)))
+            first = False
+
+        (viol_g, viol_i, n_trans, fail, n_levels, levels_dev,
+         cov_arr) = jax.device_get((
+             carry.viol_g, carry.viol_i, carry.n_trans, carry.fail,
+             carry.lvl, carry.levels, carry.cov))
+        viol_g, fail = int(viol_g), int(fail)
+        if fail:
+            raise RuntimeError(
+                f"paged search aborted: {decode_fail(fail)} "
+                f"(caps={self.caps}) — grow PagedCapacities and rerun")
+        levels_arr = [1] + [int(x) for x in levels_dev[:int(n_levels)]
+                            if int(x) > 0]
+        coverage: Counter = Counter()
+        for a, inst in enumerate(self.table):
+            if cov_arr[a]:
+                coverage[inst.family] += int(cov_arr[a])
+
+        violation = None
+        if viol_g >= 0:
+            chain_idx = host.trace_chain(viol_g)
+            chain = []
+            for k, g in enumerate(chain_idx):
+                row = host.read(int(g), 1)[0]
+                _, lane_g = host.read_links(int(g), 1)
+                py = interp.from_struct(st.unpack(row, self.lay, np),
+                                        self.bounds)
+                label = self.table[int(lane_g[0])].label() if k > 0 else None
+                chain.append((label, py))
+            violation = Violation(
+                invariant=self.config.invariants[int(viol_i)],
+                state=chain[-1][1], trace=chain)
+        host.close()
+
+        return EngineResult(
+            n_states=n_states, diameter=len(levels_arr) - 1,
+            n_transitions=int(n_trans), coverage=coverage,
+            violation=violation, levels=levels_arr,
+            wall_s=time.monotonic() - t0)
+
+
+def check(config: CheckConfig, caps: PagedCapacities | None = None,
+          **kw) -> EngineResult:
+    return PagedEngine(config, caps).check(**kw)

@@ -1,0 +1,245 @@
+"""ctypes bindings for the C++ host runtime (native/host_store.cc).
+
+Builds the shared library on first use with the baked-in g++ (no pybind11 in
+the image — SURVEY §2.8 note; plain C ABI + ctypes instead).  Every entry
+point has a NumPy fallback twin so the checker runs — more slowly and
+host-RAM-hungry — even where a toolchain is missing; ``HAS_NATIVE`` reports
+which implementation is live, and tests assert the two agree.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from raft_tla_tpu.ops import fingerprint as fpr
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native", "host_store.cc")
+_LIB_DIR = os.path.join(os.path.dirname(_SRC), "build")
+_LIB = os.path.join(_LIB_DIR, "libraft_host.so")
+
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_u32p = ctypes.POINTER(ctypes.c_uint32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+
+
+def _build() -> str | None:
+    if os.path.exists(_LIB) and (os.path.getmtime(_LIB)
+                                 >= os.path.getmtime(_SRC)):
+        return _LIB
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _LIB, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        print(f"native build failed ({e}); using NumPy fallback",
+              file=sys.stderr)
+        return None
+    return _LIB
+
+
+def _load():
+    path = _build()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.store_create.restype = ctypes.c_void_p
+    lib.store_create.argtypes = [ctypes.c_int32]
+    lib.store_destroy.argtypes = [ctypes.c_void_p]
+    lib.store_size.restype = ctypes.c_int64
+    lib.store_size.argtypes = [ctypes.c_void_p]
+    lib.store_append.restype = ctypes.c_int64
+    lib.store_append.argtypes = [ctypes.c_void_p, _i32p, ctypes.c_int64]
+    lib.store_read.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                               ctypes.c_int64, _i32p]
+    lib.store_append_links.restype = ctypes.c_int64
+    lib.store_append_links.argtypes = [ctypes.c_void_p, _i32p, _i32p,
+                                       ctypes.c_int64]
+    lib.store_read_links.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                     ctypes.c_int64, _i32p, _i32p]
+    lib.store_trace_chain.restype = ctypes.c_int64
+    lib.store_trace_chain.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                      _i64p, ctypes.c_int64]
+    lib.fingerprint_rows.argtypes = [
+        _i32p, ctypes.c_int64, ctypes.c_int32, _u32p, _u32p,
+        ctypes.c_uint32, ctypes.c_uint32, _u32p, _u32p]
+    return lib
+
+
+_lib = _load()
+HAS_NATIVE = _lib is not None
+
+
+def _as_i32(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int32)
+
+
+class HostStore:
+    """Append-only host store of packed state rows + trace links.
+
+    The TLC ``states/`` analog (SURVEY §2.8): discovery-indexed, append-only,
+    host-RAM resident.  C++-backed when the toolchain is available.
+    """
+
+    def __init__(self, width: int):
+        self.width = int(width)
+        self._h = _lib.store_create(self.width)
+
+    def __len__(self) -> int:
+        return _lib.store_size(self._h)
+
+    def append(self, rows: np.ndarray) -> int:
+        rows = _as_i32(rows).reshape(-1, self.width)
+        return _lib.store_append(
+            self._h, rows.ctypes.data_as(_i32p), rows.shape[0])
+
+    def read(self, start: int, n: int) -> np.ndarray:
+        if not (0 <= start and start + n <= len(self)):
+            raise IndexError(f"read [{start}, {start + n}) of {len(self)}")
+        out = np.empty((n, self.width), np.int32)
+        _lib.store_read(self._h, start, n, out.ctypes.data_as(_i32p))
+        return out
+
+    def append_links(self, parent: np.ndarray, lane: np.ndarray) -> int:
+        parent, lane = _as_i32(parent).ravel(), _as_i32(lane).ravel()
+        assert parent.shape == lane.shape
+        return _lib.store_append_links(
+            self._h, parent.ctypes.data_as(_i32p),
+            lane.ctypes.data_as(_i32p), parent.shape[0])
+
+    def read_links(self, start: int, n: int):
+        parent = np.empty((n,), np.int32)
+        lane = np.empty((n,), np.int32)
+        _lib.store_read_links(self._h, start, n,
+                              parent.ctypes.data_as(_i32p),
+                              lane.ctypes.data_as(_i32p))
+        return parent, lane
+
+    def trace_chain(self, from_row: int) -> np.ndarray:
+        """Discovery indices from the root to ``from_row`` (inclusive)."""
+        cap = 1 << 10
+        while True:
+            out = np.empty((cap,), np.int64)
+            n = _lib.store_trace_chain(self._h, from_row,
+                                       out.ctypes.data_as(_i64p), cap)
+            if n >= 0:
+                return out[:n]
+            cap *= 4
+
+    def close(self) -> None:
+        if self._h is not None:
+            _lib.store_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _BlockList:
+    """Appended ndarray blocks with O(log blocks) range reads (no global
+    concatenation — the C++ twin's block structure, in NumPy)."""
+
+    def __init__(self):
+        self._blocks: list = []
+        self._ends = np.zeros((0,), np.int64)   # cumulative row counts
+
+    def __len__(self) -> int:
+        return int(self._ends[-1]) if self._blocks else 0
+
+    def append(self, block: np.ndarray) -> None:
+        total = len(self) + block.shape[0]
+        self._blocks.append(block)
+        self._ends = np.append(self._ends, total)
+
+    def read(self, start: int, n: int) -> np.ndarray:
+        if n <= 0:
+            return self._blocks[0][:0] if self._blocks \
+                else np.empty((0,), np.int32)
+        out = []
+        b = int(np.searchsorted(self._ends, start, side="right"))
+        pos = start
+        while n > 0:
+            b_start = int(self._ends[b - 1]) if b else 0
+            take = min(n, int(self._ends[b]) - pos)
+            off = pos - b_start
+            out.append(self._blocks[b][off:off + take])
+            pos += take
+            n -= take
+            b += 1
+        return np.concatenate(out) if len(out) != 1 else out[0]
+
+
+class PyHostStore:
+    """NumPy fallback with the identical interface."""
+
+    def __init__(self, width: int):
+        self.width = int(width)
+        self._rows = _BlockList()
+        self._parents = _BlockList()
+        self._lanes = _BlockList()
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def append(self, rows: np.ndarray) -> int:
+        self._rows.append(_as_i32(rows).reshape(-1, self.width).copy())
+        return len(self)
+
+    def read(self, start: int, n: int) -> np.ndarray:
+        if not (0 <= start and start + n <= len(self)):
+            raise IndexError(f"read [{start}, {start + n}) of {len(self)}")
+        return self._rows.read(start, n)
+
+    def append_links(self, parent, lane) -> int:
+        self._parents.append(_as_i32(parent).ravel().copy())
+        self._lanes.append(_as_i32(lane).ravel().copy())
+        return len(self._parents)
+
+    def read_links(self, start: int, n: int):
+        return self._parents.read(start, n), self._lanes.read(start, n)
+
+    def trace_chain(self, from_row: int) -> np.ndarray:
+        chain = []
+        cur = int(from_row)
+        while cur >= 0:
+            chain.append(cur)
+            cur = int(self._parents.read(cur, 1)[0])
+        return np.asarray(chain[::-1], np.int64)
+
+    def close(self) -> None:
+        pass
+
+
+def make_store(width: int):
+    """The C++ store when available, the NumPy twin otherwise."""
+    return HostStore(width) if HAS_NATIVE else PyHostStore(width)
+
+
+def fingerprint_rows(rows: np.ndarray) -> tuple:
+    """Bit-identical host fingerprint of packed rows via the C++ path.
+
+    Falls back to the NumPy reference implementation (the definition site,
+    ops/fingerprint.py) when no toolchain is available.
+    """
+    rows = _as_i32(rows)
+    rows2d = rows.reshape(-1, rows.shape[-1])
+    if not HAS_NATIVE:
+        return fpr.fingerprint(rows2d, fpr.lane_constants(rows2d.shape[-1]),
+                               np)
+    consts = np.ascontiguousarray(fpr.lane_constants(rows2d.shape[-1]))
+    hi = np.empty((rows2d.shape[0],), np.uint32)
+    lo = np.empty((rows2d.shape[0],), np.uint32)
+    _lib.fingerprint_rows(
+        rows2d.ctypes.data_as(_i32p), rows2d.shape[0], rows2d.shape[1],
+        consts[0].ctypes.data_as(_u32p), consts[1].ctypes.data_as(_u32p),
+        int(fpr._LANE_SEEDS[0]), int(fpr._LANE_SEEDS[1]),
+        hi.ctypes.data_as(_u32p), lo.ctypes.data_as(_u32p))
+    return hi, lo

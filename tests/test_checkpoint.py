@@ -1,0 +1,58 @@
+"""Checkpoint/resume: the search is a pure function of the carry, so a
+resumed run must be bit-exact with an uninterrupted one (SURVEY §5 —
+TLC's ``states/`` + ``-recover`` analog)."""
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.device_engine import Capacities, DeviceEngine
+
+CFG = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                max_log=0, max_msgs=2),
+                  spec="election", invariants=("NoTwoLeaders",), chunk=32)
+CAPS = Capacities(n_states=1 << 13, levels=64)
+
+
+def test_checkpoint_resume_bit_exact(tmp_path):
+    ckpt = str(tmp_path / "search.ckpt")
+    eng = DeviceEngine(CFG, CAPS, seg_chunks=8)
+    eng.SEG_MAX = 8                      # force many segments on a small space
+    straight = eng.check()
+    # checkpoint_every_s=0: a snapshot after every segment; the file left
+    # behind is a mid-search carry from just before the final segments.
+    eng2 = DeviceEngine(CFG, CAPS, seg_chunks=8)
+    eng2.SEG_MAX = 8
+    res = eng2.check(checkpoint=ckpt, checkpoint_every_s=0.0)
+    assert res.n_states == straight.n_states
+
+    eng3 = DeviceEngine(CFG, CAPS, seg_chunks=8)
+    eng3.SEG_MAX = 8
+    resumed = eng3.check(resume=ckpt)
+    assert resumed.n_states == straight.n_states
+    assert resumed.diameter == straight.diameter
+    assert resumed.levels == straight.levels
+    assert resumed.n_transitions == straight.n_transitions
+    assert resumed.coverage == straight.coverage
+    assert resumed.violation is None
+
+
+def test_checkpoint_shape_mismatch_is_loud(tmp_path):
+    ckpt = str(tmp_path / "search.ckpt")
+    eng = DeviceEngine(CFG, CAPS, seg_chunks=8)
+    eng.SEG_MAX = 8
+    eng.check(checkpoint=ckpt, checkpoint_every_s=0.0)
+    other = DeviceEngine(CFG, Capacities(n_states=1 << 14, levels=64))
+    with pytest.raises(ValueError, match="checkpoint"):
+        other.check(resume=ckpt)
+
+
+def test_checkpoint_file_is_atomic_npz(tmp_path):
+    ckpt = str(tmp_path / "search.ckpt")
+    eng = DeviceEngine(CFG, CAPS, seg_chunks=8)
+    eng.SEG_MAX = 8
+    eng.check(checkpoint=ckpt, checkpoint_every_s=0.0)
+    with np.load(ckpt) as z:
+        assert int(z["width"]) == eng.lay.width
+        assert z["c0"].shape == (CAPS.n_states, eng.lay.width)
+    assert not (tmp_path / "search.ckpt.tmp").exists()

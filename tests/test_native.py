@@ -1,0 +1,93 @@
+"""C++ host runtime ≡ NumPy twins (SURVEY §2.8 native components).
+
+The fingerprint MUST be bit-identical across the np reference, the device
+path, and the C++ path — sharding routes states by fingerprint, so a single
+differing bit mis-routes a state and silently breaks dedup exactness.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.ops import fingerprint as fpr
+from raft_tla_tpu.utils import native
+
+
+def test_native_toolchain_available():
+    """The image bakes g++; the C++ path must actually be exercised here."""
+    assert native.HAS_NATIVE
+
+
+def test_fingerprint_bit_identical_cpp_vs_numpy():
+    rng = np.random.default_rng(7)
+    rows = rng.integers(-2**31, 2**31 - 1, size=(4096, 60), dtype=np.int32)
+    hi_np, lo_np = fpr.fingerprint(rows, fpr.lane_constants(60), np)
+    hi_cc, lo_cc = native.fingerprint_rows(rows)
+    np.testing.assert_array_equal(hi_np.astype(np.uint32), hi_cc)
+    np.testing.assert_array_equal(lo_np.astype(np.uint32), lo_cc)
+
+
+def test_fingerprint_bit_identical_cpp_vs_device():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(8)
+    rows = rng.integers(0, 2**20, size=(512, 33), dtype=np.int32)
+    consts = fpr.lane_constants(33)
+    hi_d, lo_d = fpr.fingerprint(jnp.asarray(rows), jnp.asarray(consts), jnp)
+    hi_cc, lo_cc = native.fingerprint_rows(rows)
+    np.testing.assert_array_equal(np.asarray(hi_d), hi_cc)
+    np.testing.assert_array_equal(np.asarray(lo_d), lo_cc)
+
+
+@pytest.mark.parametrize("cls", [native.HostStore, native.PyHostStore])
+def test_store_roundtrip(cls):
+    if cls is native.HostStore and not native.HAS_NATIVE:
+        pytest.skip("no toolchain")
+    st = cls(width=7)
+    rng = np.random.default_rng(9)
+    all_rows = []
+    for n in (1, 100, 70000, 3):        # spans the 65536-row block boundary
+        rows = rng.integers(-1000, 1000, size=(n, 7), dtype=np.int32)
+        all_rows.append(rows)
+        st.append(rows)
+    ref = np.concatenate(all_rows)
+    assert len(st) == ref.shape[0]
+    np.testing.assert_array_equal(st.read(0, len(st)), ref)
+    np.testing.assert_array_equal(st.read(65530, 20), ref[65530:65550])
+    with pytest.raises(IndexError):
+        st.read(len(st) - 1, 2)
+    st.close()
+
+
+@pytest.mark.parametrize("cls", [native.HostStore, native.PyHostStore])
+def test_links_and_trace_chain(cls):
+    if cls is native.HostStore and not native.HAS_NATIVE:
+        pytest.skip("no toolchain")
+    st = cls(width=1)
+    # a BFS-ish parent forest: row 0 is the root
+    parent = np.asarray([-1, 0, 0, 1, 3, 4, 2], np.int32)
+    lane = np.asarray([-1, 5, 6, 7, 8, 9, 10], np.int32)
+    st.append_links(parent[:4], lane[:4])
+    st.append_links(parent[4:], lane[4:])
+    p, l = st.read_links(2, 3)
+    np.testing.assert_array_equal(p, parent[2:5])
+    np.testing.assert_array_equal(l, lane[2:5])
+    np.testing.assert_array_equal(st.trace_chain(5), [0, 1, 3, 4, 5])
+    np.testing.assert_array_equal(st.trace_chain(6), [0, 2, 6])
+    np.testing.assert_array_equal(st.trace_chain(0), [0])
+    st.close()
+
+
+def test_cpp_store_matches_py_store_on_random_ops():
+    if not native.HAS_NATIVE:
+        pytest.skip("no toolchain")
+    rng = np.random.default_rng(10)
+    a, b = native.HostStore(5), native.PyHostStore(5)
+    for _ in range(20):
+        rows = rng.integers(-50, 50, size=(int(rng.integers(1, 500)), 5),
+                            dtype=np.int32)
+        a.append(rows)
+        b.append(rows)
+    assert len(a) == len(b)
+    start = int(rng.integers(0, len(a) // 2))
+    n = int(rng.integers(1, len(a) - start))
+    np.testing.assert_array_equal(a.read(start, n), b.read(start, n))
+    a.close()

@@ -1,0 +1,120 @@
+"""Paged engine ≡ oracle, with rings small enough to wrap many times.
+
+The paged engine's correctness risks are all in the ring/pageout machinery:
+frontier reads after wraparound, pause-before-overwrite, host trace
+reconstruction.  Tiny rings force every one of those paths.
+"""
+
+import pytest
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.models import interp, refbfs, spec as S
+from raft_tla_tpu.ops import msgbits as mb
+from raft_tla_tpu.paged_engine import PagedCapacities, PagedEngine
+from raft_tla_tpu.utils import native
+
+
+def bag(*ms):
+    return tuple(sorted((m, 1) for m in ms))
+
+
+def assert_parity(cfg, caps, **kw):
+    ref = refbfs.check(cfg, **kw)
+    got = PagedEngine(cfg, caps).check(**kw)
+    assert got.n_states == ref.n_states
+    assert got.diameter == ref.diameter
+    assert got.levels == ref.levels
+    assert got.n_transitions == ref.n_transitions
+    assert got.coverage == ref.coverage
+    assert (got.violation is None) == (ref.violation is None)
+    return ref, got
+
+
+def test_election_2server_ring_wraps():
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",), chunk=16)
+    # 3014 states through a 2048-row ring: wraps and pages repeatedly.
+    caps = PagedCapacities(ring=2048, table=1 << 13, levels=64)
+    _, got = assert_parity(cfg, caps)
+    assert got.violation is None and got.n_states == 3014
+
+
+def test_full_2server_ring_wraps():
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=1, max_msgs=2),
+                      spec="full",
+                      invariants=("NoTwoLeaders", "LogMatching",
+                                  "CommittedWithinLog"),
+                      chunk=16)
+    # max adjacent-level pair in this space is 8122 rows; 16384 still forces
+    # several ring wraps over the 48041-state run.
+    caps = PagedCapacities(ring=16384, table=1 << 17, levels=64)
+    _, got = assert_parity(cfg, caps)
+    assert got.violation is None
+    for fam in (S.RESTART, S.DUPLICATE, S.DROP):
+        assert got.coverage[fam] > 0
+
+
+def test_violation_trace_reconstructs_from_host_store():
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=4, max_dup=1)
+    cfg = CheckConfig(bounds=bounds, spec="election",
+                      invariants=("NaiveNoTwoLeaders",), chunk=16)
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3), votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100),
+        msgs=bag(mb.rv_response(3, 1, 1, 2)))
+    ref = refbfs.check(cfg, init_override=start)
+    caps = PagedCapacities(ring=2048, table=1 << 13, levels=64)
+    got = PagedEngine(cfg, caps).check(init_override=start)
+    assert got.violation is not None and ref.violation is not None
+    assert got.violation.invariant == "NaiveNoTwoLeaders"
+    assert got.violation.state == ref.violation.state
+    assert len(got.violation.trace) == len(ref.violation.trace)
+    trace = got.violation.trace
+    assert trace[0][0] is None and trace[0][1] == start
+    for (_l, prev), (_label, cur) in zip(trace, trace[1:]):
+        succs = [t for _i, t in interp.successors(prev, bounds,
+                                                  spec="election")]
+        assert cur in succs
+
+
+def test_ring_too_small_for_frontier_is_loud():
+    # The 3-server election frontier outgrows a 1024-row ring quickly.
+    cfg = CheckConfig(bounds=Bounds(n_servers=3, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=1),
+                      spec="election", invariants=(), chunk=16)
+    caps = PagedCapacities(ring=1024, table=1 << 19, levels=64)
+    with pytest.raises(RuntimeError, match="ring"):
+        PagedEngine(cfg, caps).check()
+
+
+def test_ring_must_cover_chunk_fanout():
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=(), chunk=1024)
+    with pytest.raises(ValueError, match="ring"):
+        PagedEngine(cfg, PagedCapacities(ring=2048, table=1 << 13))
+
+
+def test_matches_device_engine_discovery_order():
+    """Same discovery order ⇒ same first violation as DeviceEngine."""
+    from raft_tla_tpu.device_engine import Capacities, DeviceEngine
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=4, max_dup=1)
+    cfg = CheckConfig(bounds=bounds, spec="election",
+                      invariants=("NaiveNoTwoLeaders",), chunk=32)
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3), votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100),
+        msgs=bag(mb.rv_response(3, 1, 1, 2)))
+    dev = DeviceEngine(cfg, Capacities(n_states=1 << 15, levels=64)
+                       ).check(init_override=start)
+    pag = PagedEngine(cfg, PagedCapacities(ring=4096, table=1 << 15,
+                                           levels=64)
+                      ).check(init_override=start)
+    assert [l for l, _ in pag.violation.trace] == \
+        [l for l, _ in dev.violation.trace]
