@@ -56,7 +56,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--chunk", type=int, default=1024,
                    help="frontier states expanded per device step")
     p.add_argument("--cap", type=int, default=1 << 20,
-                   help="state-store capacity (device/shard engines)")
+                   help="expected distinct-state capacity: store rows for "
+                        "device/shard; fingerprint-table sizing (2 slots "
+                        "per state) for paged, whose store itself is host-"
+                        "RAM-bounded")
     p.add_argument("--levels", type=int, default=256,
                    help="max BFS depth (device/shard engines)")
     p.add_argument("--devices", type=int, default=None,

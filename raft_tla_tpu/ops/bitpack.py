@@ -1,0 +1,105 @@
+"""Bit-packed state rows — the canonical pack kernel (SURVEY §2.8).
+
+The flat ``int32[W]`` state vector (ops/state.py) spends a full 32-bit word
+on every field element, though no field needs more than 29 bits and most
+need 2-6: the 3-server/2-value flagship layout is 60 words (240 B) carrying
+~390 useful bits (~49 B).  HBM capacity and host↔device pageout bandwidth
+are the checker's scaling limits (the full 3s/2v run died when a BFS level
+pair outgrew the ring), so the paged engine stores rows *bit-packed* at
+~4-5x density and unpacks only the chunk being expanded.
+
+The packing is a static bitstream: field element w occupies bits
+``[start[w], start[w] + bits[w])`` of the row, where ``bits[w]`` is derived
+from :class:`~raft_tla_tpu.config.Bounds` capacities (one step past each
+bound, config.py) and ``start`` is the running sum.  Everything is computed
+at trace time from static widths, so pack/unpack lower to a fixed sequence
+of shifts and ors that XLA fuses into the surrounding kernel — no gathers,
+no loops.
+
+Dual-backend (``xp`` = numpy | jax.numpy), like ops/state.py: the host
+store holds the same packed bytes the device ring holds, and the trace
+decoder unpacks with the identical code path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.ops import state as st
+from raft_tla_tpu.ops.msgbits import _HI_FIELDS, _LO_FIELDS
+
+
+def _bits(max_value: int) -> int:
+    """Bits to represent values 0..max_value."""
+    return max(1, int(max_value).bit_length())
+
+
+def field_bits(bounds: Bounds) -> dict:
+    """Per-element bit width for every Layout field (pack() order)."""
+    n = bounds.n_servers
+    hi_bits = max(sh + w for sh, w in _HI_FIELDS.values())
+    lo_bits = max(sh + w for sh, w in _LO_FIELDS.values())
+    return {
+        "role": _bits(2),
+        "term": _bits(bounds.term_cap),
+        "votedFor": _bits(n),                    # 0 = Nil, else id+1
+        "commitIndex": _bits(bounds.log_cap),
+        "logLen": _bits(bounds.log_cap),
+        "logTerm": _bits(bounds.term_cap),
+        "logVal": _bits(bounds.n_values),
+        "vResp": n,                              # bitmask over servers
+        "vGrant": n,
+        "nextIndex": _bits(bounds.log_cap + 1),  # 1..Len(log)+1
+        "matchIndex": _bits(bounds.log_cap),
+        "msgHi": hi_bits,                        # 29: the packed record word
+        "msgLo": lo_bits,                        # 17
+        "msgCount": _bits(bounds.dup_cap),
+    }
+
+
+class BitSchema:
+    """Static pack plan: per-position widths, offsets, packed width."""
+
+    def __init__(self, bounds: Bounds):
+        lay = st.Layout.of(bounds)
+        fb = field_bits(bounds)
+        bits = []
+        for f in st.STATE_FIELDS:
+            bits += [fb[f]] * int(np.prod(lay.shapes[f]))
+        self.bits = np.asarray(bits, np.int64)          # [W]
+        self.start = np.concatenate(([0], np.cumsum(self.bits)[:-1]))
+        self.total_bits = int(self.bits.sum())
+        self.W = lay.width
+        self.P = (self.total_bits + 31) // 32           # packed words
+
+    def pack(self, vec, xp):
+        """``int32[..., W] -> int32[..., P]`` (uint32 bitstream in int32)."""
+        u = vec.astype(xp.uint32)
+        words = [None] * self.P
+        for w in range(self.W):
+            b, s = int(self.bits[w]), int(self.start[w])
+            v = u[..., w] & xp.uint32((1 << b) - 1)
+            o, sh = s // 32, s % 32
+            lowpart = (v << xp.uint32(sh)) if sh else v
+            words[o] = lowpart if words[o] is None else words[o] | lowpart
+            if sh + b > 32:                      # straddles two words
+                spill = v >> xp.uint32(32 - sh)
+                words[o + 1] = spill if words[o + 1] is None \
+                    else words[o + 1] | spill
+        zero = xp.zeros_like(u[..., 0])
+        cols = [zero if c is None else c for c in words]
+        return xp.stack(cols, axis=-1).astype(xp.int32)
+
+    def unpack(self, packed, xp):
+        """``int32[..., P] -> int32[..., W]``."""
+        u = packed.astype(xp.uint32)
+        cols = []
+        for w in range(self.W):
+            b, s = int(self.bits[w]), int(self.start[w])
+            o, sh = s // 32, s % 32
+            v = u[..., o] >> xp.uint32(sh) if sh else u[..., o]
+            if sh + b > 32:
+                v = v | (u[..., o + 1] << xp.uint32(32 - sh))
+            cols.append(v & xp.uint32((1 << b) - 1))
+        return xp.stack(cols, axis=-1).astype(xp.int32)

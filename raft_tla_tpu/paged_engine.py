@@ -40,10 +40,11 @@ import numpy as np
 
 from raft_tla_tpu.config import CheckConfig
 from raft_tla_tpu.device_engine import (
-    _EMPTY, _dedup_insert, Carry, FAIL_LEVEL, FAIL_PROBE,
+    _EMPTY, _dedup_insert, BUCKET, Carry, FAIL_LEVEL, FAIL_PROBE,
     FAIL_RING, FAIL_WIDTH, decode_fail, _carry_done)
 from raft_tla_tpu.engine import EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
+from raft_tla_tpu.ops import bitpack
 from raft_tla_tpu.ops import fingerprint as fpr
 from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
@@ -71,10 +72,12 @@ class PagedCapacities:
 
 
 def _build_segment(config: CheckConfig, caps: PagedCapacities, A: int,
-                   W: int):
+                   W: int, schema: bitpack.BitSchema):
     """Ring variant of device_engine._build_segment (same Carry, same loop
     structure; store/parent/lane/conflag are rings indexed by discovery
-    index mod ``ring``)."""
+    index mod ``ring``).  Ring rows are bit-packed (ops/bitpack.py) —
+    ~4-8x more frontier per HBM byte; rows unpack only for the chunk
+    being expanded."""
     B = config.chunk
     n_inv = len(config.invariants)
     step = kernels.build_step(config.bounds, config.spec,
@@ -91,7 +94,7 @@ def _build_segment(config: CheckConfig, caps: PagedCapacities, A: int,
         rows_g = start + jnp.arange(B, dtype=I32)
         row_act = rows_g < lvl_end
         ridx = rows_g & rmask
-        vecs = store[ridx]
+        vecs = schema.unpack(store[ridx], jnp)
         out = step(vecs)
         valid = out["valid"] & row_act[:, None] & conflag[ridx][:, None]
         n_trans = n_trans + jnp.sum(valid.astype(I32))
@@ -112,7 +115,7 @@ def _build_segment(config: CheckConfig, caps: PagedCapacities, A: int,
         fail = fail | (n_states + n_new - lvl_start > Rcap) * FAIL_RING
         ok = is_new & (pos - lvl_start < Rcap)
         sl = jnp.where(ok, pos & rmask, Rcap)
-        svecs = out["svecs"].reshape(B * A, W)
+        svecs = schema.pack(out["svecs"].reshape(B * A, W), jnp)
         store = store.at[sl].set(svecs, mode="drop")
         flat_b = jnp.arange(B * A, dtype=I32) // A
         flat_a = jnp.arange(B * A, dtype=I32) % A
@@ -187,18 +190,20 @@ def _build_segment(config: CheckConfig, caps: PagedCapacities, A: int,
     return segment
 
 
-def _build_init(caps: PagedCapacities, A: int, W: int):
+def _build_init(caps: PagedCapacities, A: int, P: int):
     Rcap, Lcap, Tcap = caps.ring, caps.levels, caps.table
+    TB = Tcap // BUCKET
 
-    def init(init_vec, init_key_hi, init_key_lo, init_con):
-        store = jnp.zeros((Rcap, W), I32).at[0].set(init_vec)
+    def init(init_vec_packed, init_key_hi, init_key_lo, init_con):
+        store = jnp.zeros((Rcap, P), I32).at[0].set(init_vec_packed)
         parent = jnp.full((Rcap,), -1, I32)
         lane = jnp.full((Rcap,), -1, I32)
         conflag = jnp.zeros((Rcap,), bool).at[0].set(init_con)
-        tbl_hi = jnp.full((Tcap,), _EMPTY, U32).at[
-            (init_key_lo & jnp.uint32(Tcap - 1)).astype(I32)].set(init_key_hi)
-        tbl_lo = jnp.full((Tcap,), _EMPTY, U32).at[
-            (init_key_lo & jnp.uint32(Tcap - 1)).astype(I32)].set(init_key_lo)
+        b0 = (init_key_lo & jnp.uint32(TB - 1)).astype(I32)
+        tbl_hi = jnp.full((TB, BUCKET), _EMPTY, U32).at[b0, 0].set(
+            init_key_hi)
+        tbl_lo = jnp.full((TB, BUCKET), _EMPTY, U32).at[b0, 0].set(
+            init_key_lo)
         levels = jnp.zeros((Lcap,), I32)
         return Carry(store, parent, lane, conflag, tbl_hi, tbl_lo,
                      jnp.int32(1), jnp.int32(0), jnp.int32(1),
@@ -231,9 +236,11 @@ class PagedEngine:
                 f"PagedCapacities.ring={self.caps.ring} must be >= "
                 f"2 * chunk * A = {2 * config.chunk * self.A}")
         self.seg_chunks = seg_chunks
-        self._init = jax.jit(_build_init(self.caps, self.A, self.lay.width))
+        self.schema = bitpack.BitSchema(self.bounds)
+        self._init = jax.jit(_build_init(self.caps, self.A, self.schema.P))
         self._segment = jax.jit(
-            _build_segment(config, self.caps, self.A, self.lay.width),
+            _build_segment(config, self.caps, self.A, self.lay.width,
+                           self.schema),
             donate_argnums=(0,))
         self._gather = jax.jit(
             lambda carry, ridx: (carry.store[ridx], carry.parent[ridx],
@@ -273,8 +280,9 @@ class PagedEngine:
                     violation=Violation(nm, init_py, [(None, init_py)]),
                     levels=[1], wall_s=time.monotonic() - t0)
 
-        host = native.make_store(self.lay.width)
-        carry = self._init(jnp.asarray(init_vec, I32), jnp.uint32(hi0),
+        host = native.make_store(self.schema.P)
+        init_packed = self.schema.pack(init_vec.astype(np.int32), np)
+        carry = self._init(jnp.asarray(init_packed, I32), jnp.uint32(hi0),
                            jnp.uint32(lo0),
                            jnp.bool_(interp.constraint_ok(init_py, bounds)))
         budget = max(1, self.seg_chunks)
@@ -319,7 +327,7 @@ class PagedEngine:
             chain_idx = host.trace_chain(viol_g)
             chain = []
             for k, g in enumerate(chain_idx):
-                row = host.read(int(g), 1)[0]
+                row = self.schema.unpack(host.read(int(g), 1)[0], np)
                 _, lane_g = host.read_links(int(g), 1)
                 py = interp.from_struct(st.unpack(row, self.lay, np),
                                         self.bounds)

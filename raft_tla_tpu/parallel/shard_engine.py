@@ -63,7 +63,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from raft_tla_tpu.config import CheckConfig
-from raft_tla_tpu.device_engine import _EMPTY, _dedup_insert
+from raft_tla_tpu.device_engine import _EMPTY, _dedup_insert, BUCKET
 from raft_tla_tpu.engine import EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import fingerprint as fpr
@@ -272,10 +272,11 @@ def _build_sharded_search(config: CheckConfig, caps: ShardCapacities,
         parent = jnp.full((Ncap,), -1, I32)
         lane = jnp.full((Ncap,), -1, I32)
         conflag = jnp.zeros((Ncap,), bool).at[0].set(mine & init_con)
-        islot = (init_lo & jnp.uint32(Tcap - 1)).astype(I32)
-        tbl_hi = jnp.full((Tcap,), _EMPTY, U32).at[islot].set(
+        TBd = Tcap // BUCKET
+        ib = (init_lo & jnp.uint32(TBd - 1)).astype(I32)
+        tbl_hi = jnp.full((TBd, BUCKET), _EMPTY, U32).at[ib, 0].set(
             jnp.where(mine, init_hi, _EMPTY))
-        tbl_lo = jnp.full((Tcap,), _EMPTY, U32).at[islot].set(
+        tbl_lo = jnp.full((TBd, BUCKET), _EMPTY, U32).at[ib, 0].set(
             jnp.where(mine, init_lo, _EMPTY))
         levels = jnp.zeros((Lcap,), I32)
         n0 = jnp.where(mine, 1, 0).astype(I32)

@@ -10,9 +10,11 @@ which implementation is live, and tests assert the two agree.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import sys
+import tempfile
 
 import numpy as np
 
@@ -21,7 +23,6 @@ from raft_tla_tpu.ops import fingerprint as fpr
 _SRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native", "host_store.cc")
 _LIB_DIR = os.path.join(os.path.dirname(_SRC), "build")
-_LIB = os.path.join(_LIB_DIR, "libraft_host.so")
 
 _i32p = ctypes.POINTER(ctypes.c_int32)
 _u32p = ctypes.POINTER(ctypes.c_uint32)
@@ -29,18 +30,30 @@ _i64p = ctypes.POINTER(ctypes.c_int64)
 
 
 def _build() -> str | None:
-    if os.path.exists(_LIB) and (os.path.getmtime(_LIB)
-                                 >= os.path.getmtime(_SRC)):
-        return _LIB
+    # The library file is named by the source hash: freshness is content-
+    # based (mtimes lie after a fresh clone), and concurrent builders race
+    # benignly — both produce identical bytes and the os.replace is atomic.
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    lib = os.path.join(_LIB_DIR, f"libraft_host-{digest}.so")
+    if os.path.exists(lib):
+        return lib
     os.makedirs(_LIB_DIR, exist_ok=True)
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _LIB, _SRC]
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_LIB_DIR)
+    os.close(fd)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, lib)
     except (OSError, subprocess.SubprocessError) as e:
         print(f"native build failed ({e}); using NumPy fallback",
               file=sys.stderr)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
-    return _LIB
+    return lib
 
 
 def _load():
@@ -89,6 +102,7 @@ class HostStore:
     def __init__(self, width: int):
         self.width = int(width)
         self._h = _lib.store_create(self.width)
+        self._n_links = 0
 
     def __len__(self) -> int:
         return _lib.store_size(self._h)
@@ -108,11 +122,15 @@ class HostStore:
     def append_links(self, parent: np.ndarray, lane: np.ndarray) -> int:
         parent, lane = _as_i32(parent).ravel(), _as_i32(lane).ravel()
         assert parent.shape == lane.shape
-        return _lib.store_append_links(
+        self._n_links = _lib.store_append_links(
             self._h, parent.ctypes.data_as(_i32p),
             lane.ctypes.data_as(_i32p), parent.shape[0])
+        return self._n_links
 
     def read_links(self, start: int, n: int):
+        if not (0 <= start and start + n <= self._n_links):
+            raise IndexError(
+                f"read_links [{start}, {start + n}) of {self._n_links}")
         parent = np.empty((n,), np.int32)
         lane = np.empty((n,), np.int32)
         _lib.store_read_links(self._h, start, n,
@@ -122,6 +140,9 @@ class HostStore:
 
     def trace_chain(self, from_row: int) -> np.ndarray:
         """Discovery indices from the root to ``from_row`` (inclusive)."""
+        if not (0 <= from_row < self._n_links):
+            raise IndexError(
+                f"trace_chain from {from_row} of {self._n_links}")
         cap = 1 << 10
         while True:
             out = np.empty((cap,), np.int64)
