@@ -27,6 +27,7 @@ import time
 
 EXIT_OK = 0
 EXIT_VIOLATION = 12      # TLC's exit code for safety-property violations
+EXIT_LIVENESS = 13       # TLC's exit code for liveness-property violations
 EXIT_ERROR = 1
 
 
@@ -69,6 +70,17 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--emit-tlc", metavar="DIR",
                    help="also write MCraft.tla/MCraft.cfg for a stock-TLC "
                         "parity run, then continue")
+    p.add_argument("--property", action="append", default=[],
+                   metavar="NAME",
+                   help="liveness property to check under weak fairness "
+                        "(host-side SCC analysis; registry: "
+                        "models/liveness.PROPERTIES). Also read from the "
+                        "cfg's PROPERTY stanza")
+    p.add_argument("--wf", default="Next",
+                   help="comma-separated action families assumed weakly "
+                        "fair for --property (default: Next = the whole "
+                        "relation; 'none' = no fairness, the reference "
+                        "spec's actual Spec, raft.tla:469)")
     p.add_argument("--checkpoint", metavar="PATH",
                    help="periodically snapshot the search (device engine); "
                         "resume later with --resume")
@@ -98,10 +110,13 @@ def _resolve_config(args):
         raise ValueError(
             f"unknown invariant(s) {unknown}; registry: "
             f"{sorted(inv_mod.REGISTRY)}")
-    if cfg.properties:
+    from raft_tla_tpu.models import liveness as live_mod
+    bad_props = [nm for nm in cfg.properties
+                 if nm not in live_mod.PROPERTIES]
+    if bad_props:
         raise ValueError(
-            f"PROPERTY {cfg.properties} not supported: liveness checking is "
-            "not implemented; only INVARIANT (safety) is")
+            f"unknown PROPERTY {bad_props}; registry: "
+            f"{sorted(live_mod.PROPERTIES)}")
     if cfg.symmetry:
         raise ValueError(f"SYMMETRY {cfg.symmetry} not supported")
     # Our own --emit-tlc artifacts declare the constraint/view this checker
@@ -120,8 +135,16 @@ def _resolve_config(args):
         n_values=len(cfg.value_names()),
         max_term=args.max_term, max_log=args.max_log,
         max_msgs=args.max_msgs, max_dup=args.max_dup)
+    props = list(cfg.properties) + [nm for nm in args.property
+                                     if nm not in cfg.properties]
+    bad_props = [nm for nm in props if nm not in live_mod.PROPERTIES]
+    if bad_props:
+        raise ValueError(
+            f"unknown --property {bad_props}; registry: "
+            f"{sorted(live_mod.PROPERTIES)}")
     return CheckConfig(bounds=bounds, spec=args.spec,
-                       invariants=tuple(cfg.invariants), chunk=args.chunk)
+                       invariants=tuple(cfg.invariants),
+                       chunk=args.chunk), tuple(props)
 
 
 def _run(args, config):
@@ -178,7 +201,7 @@ def main(argv=None) -> int:
                 f"(got {args.engine}); other engines would silently "
                 "ignore them")
     try:
-        config = _resolve_config(args)
+        config, props = _resolve_config(args)
     except (OSError, ValueError) as e:
         print(f"Error: {e}", file=sys.stderr)
         return EXIT_ERROR
@@ -217,6 +240,10 @@ def main(argv=None) -> int:
         for fam, cnt in sorted(result.coverage.items()):
             print(f"  {fam}: {cnt} new states")
 
+    if result.violation is None and props:
+        code = _check_liveness(args, config, props)
+        if code != EXIT_OK:
+            return code
     if result.violation is None:
         print("Model checking completed. No error has been found.")
         return EXIT_OK
@@ -226,6 +253,45 @@ def main(argv=None) -> int:
         from raft_tla_tpu.utils.render import render_trace
         print(render_trace(result.violation, b))
     return EXIT_VIOLATION
+
+
+def _check_liveness(args, config, props) -> int:
+    from raft_tla_tpu.models import liveness
+    from raft_tla_tpu.utils.render import render_state
+
+    wf = () if args.wf.strip().lower() == "none" else         tuple(f.strip() for f in args.wf.split(",") if f.strip())
+    for nm in props:
+        t0 = time.monotonic()
+        try:
+            res = liveness.check(config, nm, wf=wf)
+        except ValueError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return EXIT_ERROR
+        wall = time.monotonic() - t0
+        form = liveness.PROPERTIES[nm][0]
+        wf_txt = ", ".join(wf) if wf else "no fairness (raw Spec)"
+        print(f"Property {nm} ({form}P) under WF({wf_txt}): "
+              f"{res.n_states} states, {res.n_edges} transitions, "
+              f"{wall:.2f}s.")
+        if res.holds:
+            print(f"Property {nm} is satisfied.")
+            continue
+        print(f"Error: Property {nm} is violated.")
+        if not args.no_trace:
+            print("Error: The following behavior, repeated forever, "
+                  "refutes it:")
+            v = res.violation
+            for k, (label, state) in enumerate(v.prefix, start=1):
+                head = "<Initial predicate>" if label is None                     else f"<{label}>"
+                print(f"State {k}: {head}")
+                print(render_state(state, config.bounds))
+            n0 = len(v.prefix)
+            for k, (label, state) in enumerate(v.cycle, start=n0 + 1):
+                print(f"State {k}: <{label}>  (loop)")
+                print(render_state(state, config.bounds))
+            print(f"(the loop returns to State {n0 + 1})")
+        return EXIT_LIVENESS
+    return EXIT_OK
 
 
 if __name__ == "__main__":

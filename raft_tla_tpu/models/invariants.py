@@ -58,6 +58,33 @@ def _py_committed_within_log(s, bounds: Bounds) -> bool:
                for i in range(bounds.n_servers))
 
 
+def _py_leader_completeness(s, bounds: Bounds) -> bool:
+    """Leader Completeness (Raft Fig. 3): an entry committed in term T is
+    present in the log of every leader of a term later than T.
+
+    State-level reading without history variables: the *commit term* of an
+    entry counted by ``commitIndex[j]`` is not recorded, but it is always
+    <= ``currentTerm[j]`` — j's commitIndex moves only through its own
+    AdvanceCommitIndex (commit term = currentTerm[j], ``raft.tla:268-270``)
+    or an accepted AppendEntries with ``mterm = currentTerm[j]``
+    (``raft.tla:356-365``), and terms only grow.  So the sound check is:
+    for every j, k <= commitIndex[j], and every leader i with
+    ``currentTerm[i] > currentTerm[j]``, the identical entry sits at k in
+    log[i].  Comparing against the *entry* term instead would wrongly flag
+    stale leaders of terms between the entry term and the commit term
+    (reachable: a deposed-but-unaware leader elected before the commit).
+    """
+    n = bounds.n_servers
+    for j in range(n):
+        for k in range(s.commitIndex[j]):
+            ent = s.log[j][k]
+            for i in range(n):
+                if (s.role[i] == S.LEADER and s.term[i] > s.term[j]
+                        and (len(s.log[i]) <= k or s.log[i][k] != ent)):
+                    return False
+    return True
+
+
 # -- jnp (device) predicates: struct -> scalar bool --------------------------
 
 def _jnp_election_safety(bounds: Bounds):
@@ -106,6 +133,25 @@ def _jnp_committed_within_log(bounds: Bounds):
     return inv
 
 
+def _jnp_leader_completeness(bounds: Bounds):
+    import jax.numpy as jnp
+
+    def inv(st):
+        L = st["logTerm"].shape[1]
+        ks = jnp.arange(L)
+        committed = ks[None, :] < st["commitIndex"][:, None]      # [j, k]
+        is_leader = st["role"] == S.LEADER                        # [i]
+        later_term = st["term"][:, None] > st["term"][None, :]    # [i, j]
+        must_hold = (is_leader[:, None] & later_term)[:, :, None] \
+            & committed[None, :, :]
+        present = ks[None, :] < st["logLen"][:, None]             # [i, k]
+        same = (st["logTerm"][:, None, :] == st["logTerm"][None, :, :]) \
+            & (st["logVal"][:, None, :] == st["logVal"][None, :, :])
+        ok = present[:, None, :] & same
+        return ~jnp.any(must_hold & ~ok)
+    return inv
+
+
 # name -> (python predicate, jnp predicate builder)
 REGISTRY = {
     # The reference cfg's undefined operator, defined (see module docstring).
@@ -115,6 +161,7 @@ REGISTRY = {
     "NaiveNoTwoLeaders": (_py_naive_no_two_leaders, _jnp_naive_no_two_leaders),
     "LogMatching": (_py_log_matching, _jnp_log_matching),
     "CommittedWithinLog": (_py_committed_within_log, _jnp_committed_within_log),
+    "LeaderCompleteness": (_py_leader_completeness, _jnp_leader_completeness),
 }
 
 

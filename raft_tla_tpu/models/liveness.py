@@ -1,0 +1,377 @@
+"""Liveness checking under weak fairness — BASELINE config #5.
+
+The reference ``Spec == Init /\\ [][Next]_vars`` has **no fairness
+conjuncts** (``raft.tla:469``; SURVEY §2.7), so every liveness property is
+vacuously refutable by stuttering.  This module makes the fairness
+assumption explicit and checks eventuality properties the way TLC's
+liveness checker does at its core: find a reachable *fair lasso* — a
+prefix plus a cycle — that refutes the property, via SCC analysis of the
+bounded behavior graph.
+
+Semantics implemented (for a state predicate ``P``):
+
+- ``<>P`` (EVENTUALLY): a counterexample is a fair infinite behavior never
+  visiting ``P`` — a lasso entirely inside the ``~P`` region.
+- ``[]<>P`` (INFINITELY-OFTEN): a counterexample's *cycle* avoids ``P``;
+  the prefix may pass through ``P``.
+
+Weak fairness, per action family (the ``\\E i : Timeout(i)``-level
+disjuncts of ``Next``, SURVEY §2.5): ``WF(A)`` rules out behaviors where
+``A`` is forever enabled but never taken.  A cycle (or a stuttering
+self-loop) is **fair** iff for every assumed-fair family ``A``, the cycle
+either takes an ``A``-step or contains a state where ``A`` is disabled.
+Inside one SCC any finite set of such witness requirements can be realized
+by a single closed walk (strong connectivity), so the SCC-level check is
+exact.  The name ``Next`` means the whole relation: taking any step (or
+total deadlock) satisfies it.
+
+Bound-truncation subtlety (TLC ``CONSTRAINT`` semantics): exploration
+stops at states violating the state constraint, but action *enabledness*
+for fairness is judged on the spec, not the bound — an action whose only
+successors fall outside the bound still counts as enabled, so a stutter at
+such a state is unfair under ``WF`` of that action and is correctly
+rejected as a counterexample.
+
+The graph is built with the reference interpreter on the host — liveness
+runs on the small bounded universes where full SCC analysis is exact; the
+accelerator engines handle the (much larger) safety side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.models import interp, spec as S
+
+# -- property registry: name -> (temporal form, state predicate) -------------
+
+EVENTUALLY = "<>"
+INFINITELY_OFTEN = "[]<>"
+
+
+def _some_leader(s, bounds: Bounds) -> bool:
+    return any(r == S.LEADER for r in s.role)
+
+
+def _some_commit(s, bounds: Bounds) -> bool:
+    return any(ci > 0 for ci in s.commitIndex)
+
+
+PROPERTIES = {
+    # Raft's headline liveness claims, both refutable even under full weak
+    # fairness (dueling candidates / fault churn) — finding the refuting
+    # lasso is the point.
+    "EventuallyLeader": (EVENTUALLY, _some_leader),
+    "EventuallyCommit": (EVENTUALLY, _some_commit),
+    "InfinitelyOftenLeader": (INFINITELY_OFTEN, _some_leader),
+}
+
+
+@dataclasses.dataclass
+class LassoViolation:
+    prop: str
+    # [(action_label | None, state)] — label None on the first element.
+    prefix: list
+    # The repeating part; cycle[0] follows prefix[-1], and the step after
+    # cycle[-1] returns to cycle[0].
+    cycle: list
+
+
+@dataclasses.dataclass
+class LivenessResult:
+    prop: str
+    holds: bool
+    violation: LassoViolation | None
+    n_states: int
+    n_edges: int
+    n_sccs_checked: int
+
+
+def explore_graph(config: CheckConfig):
+    """The bounded behavior graph: states, labeled edges, enabled families.
+
+    Returns ``(states, edges, enabled, expanded)`` where ``states`` is a
+    list of PyStates in discovery order, ``edges[u] = [(aidx, v), ...]``
+    over in-bound states only, ``enabled[u]`` is the set of action families
+    with any enabled instance at u (spec-level, including out-of-bound
+    successors — see module docstring), and ``expanded[u]`` says whether u
+    satisfied the constraint (was expanded).
+    """
+    bounds = config.bounds
+    table = S.action_table(bounds, config.spec)
+    init = interp.init_state(bounds)
+    index = {init: 0}
+    states = [init]
+    edges: list = [[]]
+    enabled: list = [set()]
+    expanded = [True]
+    frontier = [0]
+    while frontier:
+        nxt = []
+        for u in frontier:
+            s = states[u]
+            if not interp.constraint_ok(s, bounds):
+                expanded[u] = False
+                continue
+            for aidx, t in interp.successors(s, bounds, table):
+                enabled[u].add(table[aidx].family)
+                v = index.get(t)
+                if v is None:
+                    v = len(states)
+                    index[t] = v
+                    states.append(t)
+                    edges.append([])
+                    enabled.append(set())
+                    expanded.append(True)
+                    nxt.append(v)
+                edges[u].append((aidx, v))
+        frontier = nxt
+    # Enabledness must be spec-level even for unexpanded states.
+    for u, s in enumerate(states):
+        if not expanded[u]:
+            for aidx, _t in interp.successors(s, bounds, table):
+                enabled[u].add(table[aidx].family)
+    return states, edges, enabled, expanded
+
+
+def _sccs(n: int, adj) -> list:
+    """Iterative Tarjan; returns SCCs as lists of node ids."""
+    UNVISITED = -1
+    low = [UNVISITED] * n
+    num = [UNVISITED] * n
+    on_stack = [False] * n
+    stack: list = []
+    out = []
+    counter = 0
+    for root in range(n):
+        if num[root] != UNVISITED:
+            continue
+        work = [(root, 0)]
+        while work:
+            u, pi = work[-1]
+            if pi == 0:
+                num[u] = low[u] = counter
+                counter += 1
+                stack.append(u)
+                on_stack[u] = True
+            recurse = False
+            for i in range(pi, len(adj[u])):
+                v = adj[u][i]
+                if num[v] == UNVISITED:
+                    work[-1] = (u, i + 1)
+                    work.append((v, 0))
+                    recurse = True
+                    break
+                if on_stack[v]:
+                    low[u] = min(low[u], num[v])
+            if recurse:
+                continue
+            if low[u] == num[u]:
+                comp = []
+                while True:
+                    v = stack.pop()
+                    on_stack[v] = False
+                    comp.append(v)
+                    if v == u:
+                        break
+                out.append(comp)
+            work.pop()
+            if work:
+                p, _ = work[-1]
+                low[p] = min(low[p], low[u])
+    return out
+
+
+def _path(adj_labeled, src: int, dsts: set):
+    """BFS path src -> (first reachable of dsts); [(aidx, node), ...]."""
+    if src in dsts:
+        return []
+    prev = {src: None}
+    frontier = [src]
+    while frontier:
+        nxt = []
+        for u in frontier:
+            for aidx, v in adj_labeled[u]:
+                if v in prev:
+                    continue
+                prev[v] = (u, aidx)
+                if v in dsts:
+                    path = []
+                    cur = v
+                    while prev[cur] is not None:
+                        pu, pa = prev[cur]
+                        path.append((pa, cur))
+                        cur = pu
+                    path.reverse()
+                    return path
+                nxt.append(v)
+        frontier = nxt
+    return None
+
+
+def check(config: CheckConfig, prop: str,
+          wf: tuple = ("Next",), graph=None) -> LivenessResult:
+    """Check ``prop`` under weak fairness of the given action families.
+
+    ``wf`` entries are action family names (``spec.ALL_FAMILIES``) or
+    ``"Next"`` for the whole relation; ``wf=()`` assumes no fairness, under
+    which any eventuality is refuted by pure stuttering (the reference
+    spec's actual situation, ``raft.tla:469``).  ``graph`` accepts a
+    prebuilt :func:`explore_graph` result so several properties can share
+    one (dominant-cost) exploration.
+    """
+    form, pred = PROPERTIES[prop]
+    bounds = config.bounds
+    table = S.action_table(bounds, config.spec)
+    for fam in wf:
+        if fam != "Next" and fam not in S.ALL_FAMILIES:
+            raise ValueError(f"unknown WF action family {fam!r}")
+
+    states, edges, enabled, expanded = graph if graph is not None \
+        else explore_graph(config)
+    n = len(states)
+    p_mask = [pred(s, bounds) for s in states]
+
+    # The candidate cycle region: ~P states; edges must stay inside it.
+    allowed = [not p for p in p_mask]
+    sub = [[v for _a, v in edges[u] if allowed[v]] if allowed[u] else []
+           for u in range(n)]
+    sub_labeled = [[(a, v) for a, v in edges[u] if allowed[v]]
+                   if allowed[u] else [] for u in range(n)]
+
+    def fair_here(nodes: list) -> dict | None:
+        """If a fair cycle exists through these nodes, witness per WF
+        family: ('edge', u, aidx, v) or ('disabled', u); None otherwise."""
+        node_set = set(nodes)
+        wit = {}
+        for fam in wf:
+            found = None
+            for u in nodes:
+                if fam == "Next":
+                    if any(v in node_set for _a, v in sub_labeled[u]):
+                        a, v = next((a, v) for a, v in sub_labeled[u]
+                                    if v in node_set)
+                        found = ("edge", u, a, v)
+                        break
+                    if not enabled[u]:
+                        found = ("disabled", u)
+                        break
+                else:
+                    hit = next((
+                        (a, v) for a, v in sub_labeled[u]
+                        if v in node_set and table[a].family == fam), None)
+                    if hit is not None:
+                        found = ("edge", u, hit[0], hit[1])
+                        break
+                    if fam not in enabled[u]:
+                        found = ("disabled", u)
+                        break
+            if found is None:
+                return None
+            wit[fam] = found
+        return wit
+
+    # Reachability of the lasso's loop node: for <>P the whole prefix must
+    # avoid P; for []<>P any path does.
+    if form == EVENTUALLY:
+        reach_adj = sub_labeled if allowed[0] else [[]] * n
+        reachable_ok = allowed[0]
+    else:
+        reach_adj = edges
+        reachable_ok = True
+
+    reach = set()
+    if reachable_ok:
+        reach.add(0)
+        frontier = [0]
+        while frontier:
+            nxt = []
+            for u in frontier:
+                for _a, v in reach_adj[u]:
+                    if v not in reach:
+                        reach.add(v)
+                        nxt.append(v)
+            frontier = nxt
+
+    def stutter_witness(u: int) -> dict | None:
+        """Pure stutter at u: fair iff every wf family is disabled there."""
+        wit = {}
+        for fam in wf:
+            dis = (not enabled[u]) if fam == "Next" \
+                else (fam not in enabled[u])
+            if not dis:
+                return None
+            wit[fam] = ("disabled", u)
+        return wit
+
+    n_checked = 0
+    best = None
+    # (a) stuttering lassos: any reachable ~P state where fairness cannot
+    # force a step (with wf=() that is every such state — the reference
+    # spec's fairness-free reality).
+    for u in sorted(reach):
+        if not allowed[u]:
+            continue
+        n_checked += 1
+        wit = stutter_witness(u)
+        if wit is not None:
+            best = ([u], wit, u)
+            break
+    # (b) real cycles: fair SCCs of the ~P subgraph.
+    if best is None:
+        for comp in _sccs(n, sub):
+            comp_r = [u for u in comp if u in reach]
+            if not comp_r:
+                continue
+            has_cycle = len(comp) > 1 or any(
+                v == comp[0] for v in sub[comp[0]])
+            if not has_cycle:
+                continue
+            n_checked += 1
+            wit = fair_here(comp)
+            if wit is not None:
+                best = (comp, wit, comp_r[0])
+                break
+
+    if best is None:
+        return LivenessResult(prop=prop, holds=True, violation=None,
+                              n_states=n, n_edges=sum(map(len, edges)),
+                              n_sccs_checked=n_checked)
+
+    nodes, wit, entry = best
+    node_set = set(nodes)
+    # Prefix: init -> entry (region-restricted for <>P).
+    prefix_steps = _path(reach_adj, 0, {entry}) or []
+    prefix = [(None, states[0])] + [
+        (table[a].label(), states[v]) for a, v in prefix_steps]
+    # Cycle: a closed walk from entry visiting EVERY fairness witness —
+    # each edge-witness is traversed, and each disabled-witness node is
+    # visited (a walk that skipped one could itself be unfair for that
+    # family: forever enabled along the walk, never taken).  Routing stays
+    # strictly inside the SCC (strong connectivity guarantees the legs).
+    scc_adj = [[(a, v) for a, v in sub_labeled[u] if v in node_set]
+               if u in node_set else [] for u in range(n)]
+    cycle = []
+    cur = entry
+    for fam, w in wit.items():
+        if w[0] == "edge":
+            _kind, u, a, v = w
+            for pa, pv in (_path(scc_adj, cur, {u}) or []):
+                cycle.append((table[pa].label(), states[pv]))
+            cycle.append((table[a].label(), states[v]))
+            cur = v
+        else:                               # ("disabled", u): visit u
+            _kind, u = w
+            for pa, pv in (_path(scc_adj, cur, {u}) or []):
+                cycle.append((table[pa].label(), states[pv]))
+            cur = u
+    for pa, pv in (_path(scc_adj, cur, {entry}) or []):
+        cycle.append((table[pa].label(), states[pv]))
+    if not cycle:
+        cycle = [("<stutter>", states[entry])]
+    violation = LassoViolation(prop=prop, prefix=prefix, cycle=cycle)
+    return LivenessResult(prop=prop, holds=False, violation=violation,
+                          n_states=n, n_edges=sum(map(len, edges)),
+                          n_sccs_checked=n_checked)

@@ -1,0 +1,156 @@
+"""Liveness under weak fairness (models/liveness.py) + LeaderCompleteness.
+
+Ground truths worth stating:
+- The reference Spec has NO fairness (raft.tla:469), so with ``wf=()``
+  every eventuality is refuted by pure stuttering at Init.
+- Under WF(Next), the bounded election-only graph is a DAG whose fair
+  behaviors all elect a leader — the property holds.
+- Under WF(Next), the full spec is refuted by a crash-loop lasso
+  (Restart of a pristine follower is a self-loop that "takes a step").
+- Every reported lasso must replay: each consecutive pair is a real
+  transition of the interpreter, and the cycle closes.
+"""
+
+import pytest
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.models import interp, invariants, liveness, refbfs
+from raft_tla_tpu.models import spec as S
+
+B2 = Bounds(n_servers=2, n_values=1, max_term=2, max_log=0, max_msgs=2)
+ELECTION = CheckConfig(bounds=B2, spec="election", invariants=())
+FULL = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                 max_log=1, max_msgs=2),
+                   spec="full", invariants=())
+
+
+def replay_lasso(v, config):
+    """Assert prefix+cycle is a real behavior and the cycle closes."""
+    bounds = config.bounds
+    table = S.action_table(bounds, config.spec)
+    seq = v.prefix + v.cycle
+    for (_, prev), (label, cur) in zip(seq, seq[1:]):
+        if label == "<stutter>":
+            assert cur == prev
+            continue
+        succs = [t for _i, t in interp.successors(prev, bounds, table)]
+        assert cur in succs, label
+    first_cycle, last = v.cycle[0][1], v.cycle[-1][1]
+    if first_cycle != last:   # non-stutter cycle must close
+        succs = [t for _i, t in interp.successors(last, bounds, table)]
+        assert first_cycle in succs
+
+
+def test_no_fairness_stutters_at_init():
+    r = liveness.check(ELECTION, "EventuallyLeader", wf=())
+    assert not r.holds
+    assert len(r.violation.prefix) == 1          # stutter right at Init
+    assert r.violation.cycle == [("<stutter>", r.violation.prefix[0][1])]
+
+
+def test_election_holds_under_wf_next():
+    r = liveness.check(ELECTION, "EventuallyLeader", wf=("Next",))
+    assert r.holds and r.violation is None
+    assert r.n_states == 3014                    # full graph was explored
+
+
+def test_full_spec_crash_loop_refutes_election_liveness():
+    r = liveness.check(FULL, "EventuallyLeader", wf=("Next",))
+    assert not r.holds
+    replay_lasso(r.violation, FULL)
+    # no state in the lasso has a leader
+    for _l, s in r.violation.prefix + r.violation.cycle:
+        assert all(role != S.LEADER for role in s.role)
+
+
+def test_per_family_fairness_rules_out_crash_loop():
+    """WF on every family: a cycle must take-or-disable each one; Timeout
+    strictly increases terms so no bounded cycle takes it, and it is
+    enabled at every leaderless in-bound state — the bounded model
+    therefore satisfies the property (the unbounded dueling-candidates
+    lasso needs unbounded terms, which the CONSTRAINT excludes)."""
+    fams = tuple(S.SPECS["full"])
+    r = liveness.check(FULL, "EventuallyLeader", wf=fams)
+    assert r.holds
+
+
+def test_infinitely_often_variant():
+    r = liveness.check(FULL, "InfinitelyOftenLeader", wf=("Next",))
+    assert not r.holds
+    replay_lasso(r.violation, FULL)
+    # the cycle avoids leaders; the prefix is unconstrained
+    for _l, s in r.violation.cycle:
+        assert all(role != S.LEADER for role in s.role)
+
+
+def test_eventually_commit_refuted_by_stutterless_churn():
+    r = liveness.check(FULL, "EventuallyCommit", wf=("Next",))
+    assert not r.holds
+    replay_lasso(r.violation, FULL)
+    for _l, s in r.violation.prefix + r.violation.cycle:
+        assert all(ci == 0 for ci in s.commitIndex)
+
+
+def test_unknown_wf_family_is_loud():
+    with pytest.raises(ValueError, match="unknown WF"):
+        liveness.check(ELECTION, "EventuallyLeader", wf=("NotAFamily",))
+
+
+# -- LeaderCompleteness (safety side of BASELINE config #5) ------------------
+
+def test_leader_completeness_holds_on_replication():
+    bounds = Bounds(n_servers=3, n_values=1, max_term=2, max_log=1,
+                    max_msgs=2)
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.FOLLOWER),
+        term=(2, 2, 2), votedFor=(1, 1, 1))
+    cfg = CheckConfig(bounds=bounds, spec="replication",
+                      invariants=("LeaderCompleteness", "LogMatching"))
+    r = refbfs.check(cfg, init_override=start)
+    assert r.violation is None and r.n_states > 100
+
+
+def test_leader_completeness_spares_stale_intermediate_leader():
+    """Reachable Raft scenario (verified against the interpreter during
+    review): s2 was elected leader in term 3 BEFORE s1's term-4 commit;
+    Fig. 3 only covers leaders of terms later than the COMMIT term (4), so
+    s2 need not hold the entry.  A formulation comparing against the
+    entry's term (2) would wrongly flag this state."""
+    bounds = Bounds(n_servers=3, n_values=2, max_term=4, max_log=2,
+                    max_msgs=2)
+    s = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.LEADER, S.FOLLOWER),
+        term=(4, 3, 4),
+        log=(((2, 1), (4, 1)), (), ((2, 1), (4, 1))),
+        commitIndex=(2, 0, 0))
+    assert invariants.py_invariant("LeaderCompleteness")(s, bounds)
+    # same verdict on the device side
+    import jax.numpy as jnp
+    import numpy as np
+    from raft_tla_tpu.ops import state as st
+    struct = st.unpack(interp.to_vec(s, bounds), st.Layout.of(bounds), np)
+    dev = invariants.jnp_invariant("LeaderCompleteness", bounds)
+    assert bool(dev({k: jnp.asarray(v) for k, v in struct.items()}))
+
+
+def test_leader_completeness_py_jnp_agree():
+    import jax.numpy as jnp
+    import numpy as np
+    from raft_tla_tpu.ops import state as st
+
+    bounds = Bounds(n_servers=3, n_values=2, max_term=3, max_log=2,
+                    max_msgs=2)
+    py = invariants.py_invariant("LeaderCompleteness")
+    dev = invariants.jnp_invariant("LeaderCompleteness", bounds)
+    # crafted: s1 leader term 3 missing s2's committed entry -> violated
+    bad = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.FOLLOWER),
+        term=(3, 2, 2), log=((), ((1, 1),), ((1, 1),)),
+        commitIndex=(0, 1, 1))
+    good = bad._replace(log=(((1, 1),), ((1, 1),), ((1, 1),)))
+    for s, want in ((bad, False), (good, True)):
+        assert py(s, bounds) is want
+        struct = st.unpack(interp.to_vec(s, bounds), st.Layout.of(bounds),
+                           np)
+        got = bool(dev({k: jnp.asarray(v) for k, v in struct.items()}))
+        assert got is want
