@@ -1,0 +1,31 @@
+"""Pallas fingerprint kernel ≡ the NumPy reference, bit for bit.
+
+Runs in Pallas interpret mode under the CPU test harness; the real-TPU
+lowering is exercised by bench/ad-hoc runs (the deployment chip is shared,
+so keep it out of the default suite).
+"""
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.ops import fingerprint as fpr
+from raft_tla_tpu.ops import pallas_fp
+
+
+@pytest.mark.parametrize("shape", [(256, 60), (300, 60), (1, 7), (512, 128)])
+def test_bit_identical_to_numpy(shape):
+    rng = np.random.default_rng(11)
+    rows = rng.integers(-2**31, 2**31 - 1, size=shape, dtype=np.int32)
+    hi_np, lo_np = fpr.fingerprint(rows, fpr.lane_constants(shape[1]), np)
+    hi_pl, lo_pl = pallas_fp.fingerprint_rows(rows, interpret=True)
+    np.testing.assert_array_equal(hi_np.astype(np.uint32), np.asarray(hi_pl))
+    np.testing.assert_array_equal(lo_np.astype(np.uint32), np.asarray(lo_pl))
+
+
+def test_padding_does_not_change_fingerprints():
+    rng = np.random.default_rng(12)
+    rows = rng.integers(0, 2**20, size=(100, 33), dtype=np.int32)
+    hi_a, lo_a = pallas_fp.fingerprint_rows(rows, interpret=True)
+    hi_b, lo_b = pallas_fp.fingerprint_rows(rows[:57], interpret=True)
+    np.testing.assert_array_equal(np.asarray(hi_a)[:57], np.asarray(hi_b))
+    np.testing.assert_array_equal(np.asarray(lo_a)[:57], np.asarray(lo_b))
