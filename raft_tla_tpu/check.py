@@ -92,6 +92,9 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="suppress the counterexample trace on violation")
     p.add_argument("--coverage", action="store_true",
                    help="print per-action coverage (TLC -coverage analog)")
+    p.add_argument("--stats", action="store_true",
+                   help="emit one JSON line of run stats per search segment "
+                        "on stderr (device/paged engines)")
     return p
 
 
@@ -147,6 +150,16 @@ def _resolve_config(args):
                        chunk=args.chunk), tuple(props)
 
 
+def _stats_cb(args):
+    if not args.stats:
+        return None
+    import json
+
+    def cb(stats):
+        print(json.dumps(stats), file=sys.stderr)
+    return cb
+
+
 def _run(args, config):
     if args.cpu:
         import jax
@@ -177,7 +190,7 @@ def _run(args, config):
         eng = PagedEngine(config, PagedCapacities(
             ring=max(ring, 1 << (2 * args.chunk * A - 1).bit_length()),
             table=table, levels=args.levels))
-        return eng.check()
+        return eng.check(on_progress=_stats_cb(args))
     if args.engine == "shard":
         from raft_tla_tpu.parallel.shard_engine import (
             ShardCapacities, ShardEngine, make_mesh)
@@ -190,7 +203,7 @@ def _run(args, config):
                                           levels=args.levels))
     return eng.check(checkpoint=args.checkpoint,
                      checkpoint_every_s=args.checkpoint_every,
-                     resume=args.resume)
+                     resume=args.resume, on_progress=_stats_cb(args))
 
 
 def main(argv=None) -> int:
@@ -200,6 +213,9 @@ def main(argv=None) -> int:
         p.error(f"--checkpoint/--resume require --engine device "
                 f"(got {args.engine}); other engines would silently "
                 "ignore them")
+    if args.stats and args.engine not in ("device", "paged"):
+        p.error(f"--stats requires --engine device or paged "
+                f"(got {args.engine})")
     try:
         config, props = _resolve_config(args)
     except (OSError, ValueError) as e:

@@ -82,6 +82,16 @@ def _fp_call(vecs, c1, c2, interpret=False):
     )(vecs, c1, c2)
 
 
+@functools.lru_cache(maxsize=None)
+def _padded_constants(W: int, Wp: int):
+    """Lane-padded int32 views of the multipliers, built once per width
+    (callers loop over row blocks of a fixed W)."""
+    ci = np.asarray(fpr.lane_constants(W)).astype(np.int32)  # same bits
+    c1 = jnp.zeros((1, Wp), jnp.int32).at[0, :W].set(ci[0])
+    c2 = jnp.zeros((1, Wp), jnp.int32).at[0, :W].set(ci[1])
+    return c1, c2
+
+
 def fingerprint_rows(vecs, interpret: bool = False):
     """``int32[B, W] -> (hi, lo) uint32[B]`` via the Pallas kernel.
 
@@ -97,12 +107,9 @@ def fingerprint_rows(vecs, interpret: bool = False):
         # the portable jnp path (XLA-fused; bit-identical by construction)
         return fpr.fingerprint(vecs, jnp.asarray(fpr.lane_constants(W)),
                                jnp)
-    consts = np.asarray(fpr.lane_constants(W))
     Wp = ((W + _LANES - 1) // _LANES) * _LANES
     Bp = ((B + _BLOCK_ROWS - 1) // _BLOCK_ROWS) * _BLOCK_ROWS
     vp = jnp.zeros((Bp, Wp), jnp.int32).at[:B, :W].set(vecs)
-    ci = consts.astype(np.int32)        # same bits, int32 compute
-    c1 = jnp.zeros((1, Wp), jnp.int32).at[0, :W].set(ci[0])
-    c2 = jnp.zeros((1, Wp), jnp.int32).at[0, :W].set(ci[1])
+    c1, c2 = _padded_constants(W, Wp)
     hi, lo = _fp_call(vp, c1, c2, interpret=interpret)
     return hi[:B].astype(jnp.uint32), lo[:B].astype(jnp.uint32)

@@ -40,8 +40,8 @@ import numpy as np
 
 from raft_tla_tpu.config import CheckConfig
 from raft_tla_tpu.device_engine import (
-    _EMPTY, _dedup_insert, BUCKET, Carry, FAIL_LEVEL, FAIL_PROBE,
-    FAIL_RING, FAIL_WIDTH, decode_fail, _carry_done)
+    _EMPTY, _dedup_insert, _progress_stats, BUCKET, Carry, FAIL_LEVEL,
+    FAIL_PROBE, FAIL_RING, FAIL_WIDTH, decode_fail, _carry_done)
 from raft_tla_tpu.engine import EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import bitpack
@@ -262,8 +262,10 @@ class PagedEngine:
             paged += n
         return paged
 
-    def check(self, init_override: interp.PyState | None = None
-              ) -> EngineResult:
+    def check(self, init_override: interp.PyState | None = None,
+              on_progress=None) -> EngineResult:
+        """``on_progress`` as in DeviceEngine.check: structured per-segment
+        run stats (SURVEY §5)."""
         t0 = time.monotonic()
         bounds = self.bounds
         init_py = init_override if init_override is not None \
@@ -297,6 +299,8 @@ class PagedEngine:
                                         jnp.int32(pause_at))
             n_states = int(carry.n_states)
             paged = self._pageout(carry, host, paged, n_states)
+            if on_progress is not None:
+                on_progress(_progress_stats(carry, t0))
             if bool(done):
                 break
             dt = time.monotonic() - t_seg
