@@ -92,6 +92,10 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="suppress the counterexample trace on violation")
     p.add_argument("--coverage", action="store_true",
                    help="print per-action coverage (TLC -coverage analog)")
+    p.add_argument("--symmetry", action="store_true",
+                   help="quotient the state space by Server permutation "
+                        "symmetry (TLC SYMMETRY analog; also enabled by a "
+                        "cfg SYMMETRY stanza)")
     p.add_argument("--stats", action="store_true",
                    help="emit one JSON line of run stats per search segment "
                         "on stderr (device/paged engines)")
@@ -120,8 +124,14 @@ def _resolve_config(args):
         raise ValueError(
             f"unknown PROPERTY {bad_props}; registry: "
             f"{sorted(live_mod.PROPERTIES)}")
-    if cfg.symmetry:
-        raise ValueError(f"SYMMETRY {cfg.symmetry} not supported")
+    sym_names = set(cfg.symmetry) | ({"Server"} if args.symmetry else set())
+    bad_sym = sym_names - {"Server", "SymServer"}
+    if bad_sym:
+        raise ValueError(
+            f"SYMMETRY {sorted(bad_sym)} not supported: only Server "
+            "permutation symmetry is implemented (name it Server or "
+            "SymServer)")
+    symmetry = ("Server",) if sym_names else ()
     # Our own --emit-tlc artifacts declare the constraint/view this checker
     # builds in; anything else would be silently unchecked.
     if [c for c in cfg.constraints if c != "StateConstraint"]:
@@ -146,7 +156,7 @@ def _resolve_config(args):
             f"unknown --property {bad_props}; registry: "
             f"{sorted(live_mod.PROPERTIES)}")
     return CheckConfig(bounds=bounds, spec=args.spec,
-                       invariants=tuple(cfg.invariants),
+                       invariants=tuple(cfg.invariants), symmetry=symmetry,
                        chunk=args.chunk), tuple(props)
 
 
@@ -230,12 +240,15 @@ def main(argv=None) -> int:
     print(f"Constraint: MaxTerm={b.max_term} MaxLogLen={b.max_log} "
           f"MaxMsgs={b.max_msgs} MaxDup={b.max_dup}")
     print(f"Invariants: {', '.join(config.invariants) or '(none)'}")
+    if config.symmetry:
+        print("Symmetry: Server permutations (counting orbits)")
 
     if args.emit_tlc:
         from raft_tla_tpu.models import tla_export
         try:
             tla, cfgp = tla_export.export(args.emit_tlc, b,
-                                          config.invariants)
+                                          config.invariants,
+                                          symmetry=bool(config.symmetry))
         except (OSError, ValueError) as e:
             print(f"Error: {e}", file=sys.stderr)
             return EXIT_ERROR

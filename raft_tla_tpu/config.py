@@ -108,5 +108,6 @@ class CheckConfig:
     bounds: Bounds = dataclasses.field(default_factory=Bounds)
     spec: str = "full"                     # full | election | replication
     invariants: tuple = ("NoTwoLeaders",)  # registry names
+    symmetry: tuple = ()                   # () or ("Server",): TLC SYMMETRY
     chunk: int = 1024                      # frontier states expanded per jit call
     check_deadlock: bool = False           # TLC -deadlock analog (off: Restart is always enabled anyway)

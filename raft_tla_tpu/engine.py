@@ -48,6 +48,7 @@ from raft_tla_tpu.models import interp, spec as S
 from raft_tla_tpu.ops import fingerprint as fpr
 from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
+from raft_tla_tpu.ops import symmetry as sym_mod
 
 
 @dataclasses.dataclass
@@ -115,7 +116,8 @@ class Engine:
         self.A = len(self.table)
         self.chunk = config.chunk
         self._step = jax.jit(kernels.build_step(
-            self.bounds, config.spec, tuple(config.invariants)))
+            self.bounds, config.spec, tuple(config.invariants),
+            config.symmetry))
 
     # -- public API ----------------------------------------------------------
 
@@ -136,8 +138,8 @@ class Engine:
             else interp.init_state(bounds)
         init_vec = interp.to_vec(init_py, bounds)
         init_struct = interp.to_struct(init_py, bounds)
-        consts = fpr.lane_constants(W)
-        hi0, lo0 = fpr.fingerprint(init_vec.astype(np.int32), consts, np)
+        hi0, lo0 = sym_mod.init_fingerprint(self.config, init_py,
+                                            init_vec)
         init_key = int(fpr.to_u64(hi0, lo0))
 
         seen: set[int] = {init_key}

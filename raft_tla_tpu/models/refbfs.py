@@ -48,11 +48,19 @@ def check(config: CheckConfig, max_states: int | None = None,
     bounds = config.bounds
     table = S.action_table(bounds, config.spec)
     invs = [(nm, invariants.py_invariant(nm)) for nm in config.invariants]
+    if config.symmetry:
+        from raft_tla_tpu.ops import symmetry as sym_mod
+        keyf = lambda s: sym_mod.py_orbit_fingerprint(s, bounds)  # noqa: E731
+    else:
+        keyf = lambda s: s                                        # noqa: E731
     t0 = time.monotonic()
 
     init = init_override if init_override is not None \
         else interp.init_state(bounds)
-    seen = {init: None}          # state -> (parent_state, action_idx) | None
+    # key(state) -> (parent_state, action_idx) | None; with SYMMETRY the
+    # key is the orbit fingerprint, so one orbit keeps one entry (TLC
+    # semantics: the first-discovered member is the stored witness).
+    seen = {keyf(init): None}
     levels = [1]
     coverage: Counter = Counter()
     n_transitions = 0
@@ -62,7 +70,7 @@ def check(config: CheckConfig, max_states: int | None = None,
         chain = []
         cur = s
         while cur is not None:
-            entry = seen[cur]
+            entry = seen[keyf(cur)]
             chain.append((table[entry[1]].label() if entry else None, cur))
             cur = entry[0] if entry else None
         chain.reverse()
@@ -80,9 +88,10 @@ def check(config: CheckConfig, max_states: int | None = None,
                 continue  # counted, invariant-checked, but not expanded
             for aidx, t in interp.successors(s, bounds, table):
                 n_transitions += 1
-                if t in seen:
+                k = keyf(t)
+                if k in seen:
                     continue
-                seen[t] = (s, aidx)
+                seen[k] = (s, aidx)
                 coverage[table[aidx].family] += 1
                 for nm, fn in invs:
                     if not fn(t, bounds):

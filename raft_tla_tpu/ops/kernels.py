@@ -437,7 +437,8 @@ def build_expand(bounds: Bounds, spec: str = "full"):
     return expand
 
 
-def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = ()):
+def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
+               symmetry: tuple = ()):
     """One fused frontier step: packed vecs -> everything the engine needs.
 
     ``step(vecs[B, W]) -> dict`` with packed successors ``svecs [B, A, W]``,
@@ -446,8 +447,14 @@ def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = ()):
     StateConstraint satisfaction ``con_ok [B, A]``.  Everything downstream of
     the expansion fuses into one XLA computation — one device round-trip per
     frontier chunk.
+
+    With ``symmetry=("Server",)`` the fingerprint lanes become the
+    orbit-minimal fingerprint over all server permutations
+    (ops/symmetry.py) — the dedup key that quotients the state space the
+    way TLC's SYMMETRY stanza does.
     """
     from raft_tla_tpu.models import invariants as inv_mod
+    from raft_tla_tpu.ops import symmetry as sym
 
     lay = st.Layout.of(bounds)
     consts = jnp.asarray(fpr.lane_constants(lay.width))
@@ -458,7 +465,12 @@ def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = ()):
         structs = jax.vmap(lambda v: st.unpack(v, lay, jnp))(vecs)
         succs, valid, ovf = jax.vmap(expand)(structs)
         svecs = jax.vmap(jax.vmap(lambda t: st.pack(t, jnp)))(succs)
-        fp_hi, fp_lo = fpr.fingerprint(svecs, consts, jnp)
+        if symmetry:
+            fp_hi, fp_lo = jax.vmap(jax.vmap(
+                lambda t: sym.orbit_fingerprint(t, bounds, consts, jnp))
+            )(succs)
+        else:
+            fp_hi, fp_lo = fpr.fingerprint(svecs, consts, jnp)
         if inv_fns:
             inv_ok = jnp.stack(
                 [jax.vmap(jax.vmap(f))(succs) for f in inv_fns], axis=-1)

@@ -1,0 +1,146 @@
+"""Symmetry reduction over the Server model values (TLC SYMMETRY stanza).
+
+The reference binds ``Server`` to model values (``raft.cfg:6``), which TLC
+can quotient by permutation symmetry (its classic state-space reduction —
+the spec never distinguishes individual servers).  This module implements
+the same reduction for the tensor checker, the TPU way:
+
+The dedup key of a state becomes its **orbit-minimal fingerprint**:
+``min over all permutations π of fp(canonicalize(π(s)))``, where ``π(s)``
+renumbers every server-indexed axis and server-valued field.  The min is
+orbit-invariant, so two states equal up to server renaming share one key
+and one store row — the reachable count becomes the orbit count, exactly
+TLC's SYMMETRY semantics (including its property: the stored witness per
+orbit is whichever member was discovered first).  On device this is |π|
+static transforms batched over the candidate block — pure gathers, bit
+arithmetic, and the existing canonicalize/pack/fingerprint pipeline, fused
+by XLA; no extra passes over HBM.
+
+Permuting one state under ``p`` (new index of old server j is ``p[j]``):
+
+- per-server axes (role, term, votedFor, commitIndex, logLen, log*,
+  vResp, vGrant): rows reordered by the inverse permutation;
+- server-valued *contents*: ``votedFor`` ids map through ``p`` (0 = Nil
+  fixed); vote bitmasks move bit j to bit ``p[j]``;
+- ``nextIndex``/``matchIndex`` reorder both axes;
+- message records rewrite their ``src``/``dst`` fields through ``p``
+  (occupied slots only — empty slots stay all-zero), then the bag
+  re-canonicalizes (sort order may change under renaming).
+
+``Value`` symmetry is not implemented this round (the reference cfg names
+no SYMMETRY at all; Server is the axis the state space actually explodes
+in).
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import math
+
+import numpy as np
+
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.ops import fingerprint as fpr
+from raft_tla_tpu.ops import msgbits as mb
+from raft_tla_tpu.ops import state as st
+
+MAX_SYM_SERVERS = 6      # 720 permutations; beyond this the orbit pass dwarfs the step
+
+
+def permutations(bounds: Bounds) -> tuple:
+    if bounds.n_servers > MAX_SYM_SERVERS:
+        raise ValueError(
+            f"Server symmetry supports at most {MAX_SYM_SERVERS} servers "
+            f"(got {bounds.n_servers}: {math.factorial(bounds.n_servers)}"
+            " permutations)")
+    return tuple(itertools.permutations(range(bounds.n_servers)))
+
+
+def permute_struct(struct: dict, p: tuple, bounds: Bounds, xp) -> dict:
+    """Apply server permutation ``p`` to one state struct (then the caller
+    must re-canonicalize the message bag)."""
+    n = bounds.n_servers
+    inv = tuple(p.index(k) for k in range(n))      # new row k = old row inv[k]
+    inv_idx = xp.asarray(inv)
+    # votedFor lookup: 0 stays Nil, id j+1 -> p[j]+1
+    vf_map = xp.asarray((0,) + tuple(p[j] + 1 for j in range(n)))
+
+    def rows(a):
+        return a[inv_idx, ...]
+
+    def bitperm(mask):
+        out = xp.zeros_like(mask)
+        for j in range(n):
+            out = out | (((mask >> j) & 1) << p[j])
+        return out
+
+    # src/dst fields of occupied message slots, via the packed hi word
+    s_sh, s_w = mb._HI_FIELDS["src"]
+    d_sh, d_w = mb._HI_FIELDS["dst"]
+    keep = ~(((1 << s_w) - 1) << s_sh | ((1 << d_w) - 1) << d_sh)
+    hi = struct["msgHi"]
+    occupied = struct["msgCount"] > 0
+    p_lut = xp.asarray(p + tuple(0 for _ in range(16 - n)))  # 4-bit fields
+    new_hi = (hi & keep) | (p_lut[(hi >> s_sh) & ((1 << s_w) - 1)] << s_sh) \
+        | (p_lut[(hi >> d_sh) & ((1 << d_w) - 1)] << d_sh)
+    new_hi = xp.where(occupied, new_hi, hi)
+
+    return {
+        "role": rows(struct["role"]),
+        "term": rows(struct["term"]),
+        "votedFor": vf_map[rows(struct["votedFor"])],
+        "commitIndex": rows(struct["commitIndex"]),
+        "logLen": rows(struct["logLen"]),
+        "logTerm": rows(struct["logTerm"]),
+        "logVal": rows(struct["logVal"]),
+        "vResp": bitperm(rows(struct["vResp"])),
+        "vGrant": bitperm(rows(struct["vGrant"])),
+        "nextIndex": struct["nextIndex"][inv_idx, :][:, inv_idx],
+        "matchIndex": struct["matchIndex"][inv_idx, :][:, inv_idx],
+        "msgHi": new_hi,
+        "msgLo": struct["msgLo"],
+        "msgCount": struct["msgCount"],
+    }
+
+
+def orbit_fingerprint(struct: dict, bounds: Bounds, consts, xp):
+    """Orbit-minimal (hi, lo) fingerprint of one canonical state struct."""
+    best_hi = best_lo = None
+    for p in permutations(bounds):
+        t = st.canonicalize(permute_struct(struct, p, bounds, xp), xp)
+        hi, lo = fpr.fingerprint(st.pack(t, xp), consts, xp)
+        if best_hi is None:
+            best_hi, best_lo = hi, lo
+        else:
+            take = (hi < best_hi) | ((hi == best_hi) & (lo < best_lo))
+            best_hi = xp.where(take, hi, best_hi)
+            best_lo = xp.where(take, lo, best_lo)
+    return best_hi, best_lo
+
+
+@functools.lru_cache(maxsize=None)
+def _host_consts(width: int) -> np.ndarray:
+    # one PCG64 spin-up per width, not per call (refbfs keys every
+    # transition through here under symmetry)
+    return fpr.lane_constants(width)
+
+
+def py_orbit_fingerprint(s, bounds: Bounds) -> tuple:
+    """Oracle-side orbit key of a PyState — same arithmetic, NumPy."""
+    from raft_tla_tpu.models import interp
+
+    lay = st.Layout.of(bounds)
+    struct = st.unpack(interp.to_vec(s, bounds), lay, np)
+    hi, lo = orbit_fingerprint(struct, bounds, _host_consts(lay.width), np)
+    return int(hi), int(lo)
+
+
+def init_fingerprint(config, init_py, init_vec) -> tuple:
+    """The dedup key of the initial state, orbit-reduced when the run has
+    SYMMETRY — one definition for every engine's table seeding."""
+    if config.symmetry:
+        return py_orbit_fingerprint(init_py, config.bounds)
+    consts = _host_consts(init_vec.shape[-1])
+    hi, lo = fpr.fingerprint(init_vec.astype(np.int32), consts, np)
+    return int(hi), int(lo)

@@ -48,6 +48,7 @@ from raft_tla_tpu.ops import bitpack
 from raft_tla_tpu.ops import fingerprint as fpr
 from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
+from raft_tla_tpu.ops import symmetry as sym_mod
 from raft_tla_tpu.utils import native
 
 I32 = jnp.int32
@@ -81,7 +82,7 @@ def _build_segment(config: CheckConfig, caps: PagedCapacities, A: int,
     B = config.chunk
     n_inv = len(config.invariants)
     step = kernels.build_step(config.bounds, config.spec,
-                              tuple(config.invariants))
+                              tuple(config.invariants), config.symmetry)
     Rcap, Lcap = caps.ring, caps.levels
     rmask = Rcap - 1
     BIG = jnp.int32(np.iinfo(np.int32).max)
@@ -271,8 +272,8 @@ class PagedEngine:
         init_py = init_override if init_override is not None \
             else interp.init_state(bounds)
         init_vec = interp.to_vec(init_py, bounds)
-        consts = fpr.lane_constants(self.lay.width)
-        hi0, lo0 = fpr.fingerprint(init_vec.astype(np.int32), consts, np)
+        hi0, lo0 = sym_mod.init_fingerprint(self.config, init_py,
+                                            init_vec)
 
         for nm in self.config.invariants:
             if not inv_mod.py_invariant(nm)(init_py, bounds):

@@ -69,6 +69,7 @@ from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import fingerprint as fpr
 from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
+from raft_tla_tpu.ops import symmetry as sym_mod
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -114,7 +115,7 @@ def _build_sharded_search(config: CheckConfig, caps: ShardCapacities,
     if n_inv > 29:
         raise ValueError("at most 29 invariants (bit-packed into int32 flags)")
     step = kernels.build_step(config.bounds, config.spec,
-                              tuple(config.invariants))
+                              tuple(config.invariants), config.symmetry)
     Ncap, Lcap, Tcap = caps.n_states, caps.levels, caps.table
     Csend = caps.send if caps.send is not None else B * A
     BIG = jnp.int32(np.iinfo(np.int32).max)
@@ -337,8 +338,8 @@ class ShardEngine:
         init_py = init_override if init_override is not None \
             else interp.init_state(bounds)
         init_vec = interp.to_vec(init_py, bounds)
-        consts = fpr.lane_constants(self.lay.width)
-        hi0, lo0 = fpr.fingerprint(init_vec.astype(np.int32), consts, np)
+        hi0, lo0 = sym_mod.init_fingerprint(self.config, init_py,
+                                            init_vec)
 
         for nm in self.config.invariants:
             if not inv_mod.py_invariant(nm)(init_py, bounds):
