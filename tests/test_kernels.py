@@ -123,3 +123,18 @@ def test_step_outputs_consistent():
             assert out["inv_ok"][bi, ai, 0] == es(t, bounds)
             assert out["inv_ok"][bi, ai, 1] == lm(t, bounds)
             assert out["con_ok"][bi, ai] == interp.constraint_ok(t, bounds)
+
+
+def test_differential_5server_north_star_universe():
+    """The north-star universe (BASELINE config #4: 5 servers, 2 values,
+    default bounds): the 90-lane action table and kernels must agree with
+    the interpreter on random bounded states, incl. the wider
+    bitmask/quorum arithmetic and every message slot."""
+    bounds = Bounds(n_servers=5, n_values=2, max_term=3, max_log=2,
+                    max_msgs=4)
+    table = SP.action_table(bounds, "full")
+    assert len(table) == 5 + 5 + 25 + 5 + 10 + 5 + 20 + 3 * bounds.msg_cap
+    rng = np.random.default_rng(21)
+    states = [random_pystate(rng, bounds) for _ in range(24)]
+    states.append(interp.init_state(bounds))
+    _diff_on_states(states, bounds, "full")
