@@ -23,6 +23,24 @@ from raft_tla_tpu.utils.cfgparse import parse_cfg
 REF_CFG = "/root/reference/raft.cfg"
 
 
+
+_CFG_CONSTANTS = (
+    "CONSTANTS\n"
+    "    Server = {%s}\n    Value = {v1}\n"
+    '    Follower = "Follower"\n    Candidate = "Candidate"\n'
+    '    Leader = "Leader"\n    Nil = "Nil"\n'
+    '    RequestVoteRequest = "RequestVoteRequest"\n'
+    '    RequestVoteResponse = "RequestVoteResponse"\n'
+    '    AppendEntriesRequest = "AppendEntriesRequest"\n'
+    '    AppendEntriesResponse = "AppendEntriesResponse"\n')
+
+
+def write_cfg(path, servers="s1, s2", extra=""):
+    path.write_text("SPECIFICATION Spec\nINVARIANT NoTwoLeaders\n"
+                    + extra + _CFG_CONSTANTS % servers)
+    return str(path)
+
+
 def run_cli(*argv):
     buf = io.StringIO()
     with redirect_stdout(buf):
@@ -146,3 +164,31 @@ def test_tla_export_structure(tmp_path):
 def test_tla_export_unknown_invariant(tmp_path):
     with pytest.raises(ValueError, match="no TLA\\+ export"):
         tla_export.emit_module(Bounds(), ("NotAnInvariant",))
+
+
+def test_cli_liveness_property_stanza(tmp_path):
+    """cfg PROPERTY stanza drives liveness; refuted -> TLC exit 13."""
+    cfgp = write_cfg(tmp_path / "live.cfg",
+                     extra="PROPERTY EventuallyLeader\n")
+    code, out = run_cli(cfgp, "--engine", "ref", "--spec", "full",
+                        "--max-term", "2", "--max-log", "1",
+                        "--max-msgs", "2", "--wf", "Next", "--no-trace")
+    assert code == 13
+    assert "Property EventuallyLeader is violated" in out
+    # satisfied on the election subset under the same fairness
+    code2, out2 = run_cli(cfgp, "--engine", "ref", "--spec",
+                          "election", "--max-term", "2", "--max-log", "0",
+                          "--max-msgs", "2", "--wf", "Next")
+    assert code2 == cli.EXIT_OK
+    assert "Property EventuallyLeader is satisfied" in out2
+
+
+def test_cli_symmetry_flag(tmp_path):
+    cfgp = write_cfg(tmp_path / "sym.cfg")
+    args = (cfgp, "--engine", "ref", "--spec", "election",
+            "--max-term", "2", "--max-log", "0", "--max-msgs", "2")
+    code, out = run_cli(*args, "--symmetry")
+    assert code == cli.EXIT_OK
+    assert "Symmetry: Server permutations" in out
+    m = re.search(r"(\d+) distinct states found", out)
+    assert int(m.group(1)) == 1514          # orbits of the 3014-state space
