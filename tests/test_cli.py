@@ -191,4 +191,5 @@ def test_cli_symmetry_flag(tmp_path):
     assert code == cli.EXIT_OK
     assert "Symmetry: Server permutations" in out
     m = re.search(r"(\d+) distinct states found", out)
+    assert m, out
     assert int(m.group(1)) == 1514          # orbits of the 3014-state space
