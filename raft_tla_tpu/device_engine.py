@@ -412,6 +412,7 @@ class DeviceEngine:
     # Adaptive segment sizing: target seconds of device time per dispatch,
     # far enough under the ~60 s watchdog to absorb a 2-3x misprediction.
     SEG_TARGET_S = 8.0
+    SEG_CLAMP_S = 25.0       # hard ceiling on projected segment seconds
     SEG_MIN, SEG_MAX = 16, 1 << 16
 
     def __init__(self, config: CheckConfig, caps: Capacities | None = None,
@@ -513,6 +514,7 @@ class DeviceEngine:
         # is excluded from the timing signal).
         budget = max(1, self.seg_chunks)    # 0/negative would spin forever
         first = True
+        worst_s_per_chunk = 0.0
         last_ckpt = time.monotonic()
         while True:
             t_seg = time.monotonic()
@@ -527,9 +529,19 @@ class DeviceEngine:
                 last_ckpt = time.monotonic()
             dt = time.monotonic() - t_seg
             if not first and dt > 0.05:
+                # In the run's cheap tail (tiny ragged levels) the budget
+                # ramps geometrically; the next wide level would then run
+                # one segment far past the tunnel watchdog, killing the
+                # worker mid-RPC.  Clamp so projected segment time stays
+                # under SEG_CLAMP_S at the worst chunk cost seen (dt/budget
+                # underestimates it when a segment exits early — only the
+                # final segments, harmless).
+                worst_s_per_chunk = max(worst_s_per_chunk, dt / budget)
                 scale = min(2.0, max(0.25, self.SEG_TARGET_S / dt))
                 budget = int(min(self.SEG_MAX,
                                  max(self.SEG_MIN, budget * scale)))
+                budget = max(self.SEG_MIN, min(
+                    budget, int(self.SEG_CLAMP_S / worst_s_per_chunk)))
                 self.seg_chunks = budget    # warm check() calls start tuned
             first = False
         # One batched transfer for all the small outputs; the wide arrays

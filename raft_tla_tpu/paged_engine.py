@@ -219,6 +219,7 @@ class PagedEngine:
     """Exhaustive checker bounded by host RAM, not HBM."""
 
     SEG_TARGET_S = 8.0
+    SEG_CLAMP_S = 25.0       # see DeviceEngine: watchdog-overshoot guard
     SEG_MIN, SEG_MAX = 16, 1 << 16
 
     def __init__(self, config: CheckConfig, caps: PagedCapacities | None =
@@ -291,6 +292,7 @@ class PagedEngine:
         budget = max(1, self.seg_chunks)
         paged = 0
         first = True
+        worst_s_per_chunk = 0.0
         while True:
             # Pause the device loop before unpaged rows could be overwritten:
             # rows < pause_at are safe while n_states - lvl_start <= ring.
@@ -306,9 +308,12 @@ class PagedEngine:
                 break
             dt = time.monotonic() - t_seg
             if not first and dt > 0.05:
+                worst_s_per_chunk = max(worst_s_per_chunk, dt / budget)
                 scale = min(2.0, max(0.25, self.SEG_TARGET_S / dt))
                 budget = int(min(self.SEG_MAX,
                                  max(self.SEG_MIN, budget * scale)))
+                budget = max(self.SEG_MIN, min(
+                    budget, int(self.SEG_CLAMP_S / worst_s_per_chunk)))
             first = False
 
         (viol_g, viol_i, n_trans, fail, n_levels, levels_dev,
