@@ -12,9 +12,9 @@ does with its disk-backed ``states/`` queue (reference ``.gitignore:2``):
   window ``[lvl_start, n_states)`` is contiguous, so ring reuse is safe while
   the window fits (checked loudly: FAIL_RING).
 - **Every new state pages out to the C++ host store** (utils/native.py)
-  after each watchdog segment, with its (parent, lane) trace links — one
-  batched device→host transfer per segment, bucketed to limit recompiles.
-  Host RAM (then disk) is the capacity bound, not HBM.
+  after each watchdog segment, with its (parent, lane) trace links, via a
+  single fixed-shape gather (mid-run XLA compiles wedge the deployment
+  tunnel).  Host RAM (then disk) is the capacity bound, not HBM.
 - **Only the fingerprint table scales with the full space** on device:
   8 B/slot at load ≤ 0.5 → ~16 B/state, an order of magnitude less than
   storing states.  ~64M states fit in ~1 GiB of table.
@@ -248,15 +248,21 @@ class PagedEngine:
             lambda carry, ridx: (carry.store[ridx], carry.parent[ridx],
                                  carry.lane[ridx]))
 
+    # Fixed pageout gather width: ONE compiled gather shape for the whole
+    # run.  A size ladder would trigger a fresh XLA compile the first time
+    # a segment's new-state count crossed each bucket — and on the
+    # deployment tunnel a mid-run compile against a busy device wedges the
+    # worker (observed repeatedly ~13 min into large runs).  Padding waste
+    # is bounded at PAGE_ROWS rows (~2 MB packed) per segment.
+    PAGE_ROWS = 1 << 16
+
     def _pageout(self, carry, host, paged: int, n_states: int) -> int:
         """Copy rows [paged, n_states) from the device ring to the host
-        store.  Bucketed padding keeps the gather jit-cache small."""
+        store, PAGE_ROWS at a time."""
+        iota = np.arange(self.PAGE_ROWS, dtype=np.int32)
         while paged < n_states:
-            n = min(n_states - paged, self.caps.ring)
-            bucket = 1 << (max(n - 1, 0)).bit_length()
-            bucket = max(bucket, 1024)
-            gidx = paged + np.arange(bucket, dtype=np.int32)
-            gidx = np.minimum(gidx, n_states - 1)       # pad with last row
+            n = min(n_states - paged, self.PAGE_ROWS)
+            gidx = np.minimum(paged + iota, n_states - 1)   # pad w/ last row
             ridx = jnp.asarray(gidx & (self.caps.ring - 1))
             rows, par, lan = jax.device_get(self._gather(carry, ridx))
             host.append(rows[:n])
