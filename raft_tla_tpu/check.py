@@ -84,7 +84,7 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint", metavar="PATH",
                    help="periodically snapshot the search (device engine); "
                         "resume later with --resume")
-    p.add_argument("--checkpoint-every", type=float, default=600.0,
+    p.add_argument("--checkpoint-every", type=float, default=120.0,
                    metavar="SECONDS")
     p.add_argument("--resume", metavar="PATH",
                    help="resume a --checkpoint snapshot (device engine)")
@@ -200,7 +200,10 @@ def _run(args, config):
         eng = PagedEngine(config, PagedCapacities(
             ring=max(ring, 1 << (2 * args.chunk * A - 1).bit_length()),
             table=table, levels=args.levels))
-        return eng.check(on_progress=_stats_cb(args))
+        return eng.check(on_progress=_stats_cb(args),
+                         checkpoint=args.checkpoint,
+                         checkpoint_every_s=args.checkpoint_every,
+                         resume=args.resume)
     if args.engine == "shard":
         from raft_tla_tpu.parallel.shard_engine import (
             ShardCapacities, ShardEngine, make_mesh)
@@ -219,8 +222,9 @@ def _run(args, config):
 def main(argv=None) -> int:
     p = build_argparser()
     args = p.parse_args(argv)
-    if (args.checkpoint or args.resume) and args.engine != "device":
-        p.error(f"--checkpoint/--resume require --engine device "
+    if (args.checkpoint or args.resume) and args.engine not in ("device",
+                                                                 "paged"):
+        p.error(f"--checkpoint/--resume require --engine device or paged "
                 f"(got {args.engine}); other engines would silently "
                 "ignore them")
     if args.stats and args.engine not in ("device", "paged"):

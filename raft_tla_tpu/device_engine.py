@@ -68,6 +68,7 @@ from raft_tla_tpu.ops import fingerprint as fpr
 from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym_mod
+from raft_tla_tpu.utils import ckpt
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -440,36 +441,26 @@ class DeviceEngine:
     # A checkpoint is the full carry — the search is a pure function of it,
     # so resume is exact: same discovery order, counts, traces.
 
-    def _ckpt_digest(self) -> int:
-        """Pins model identity: explored states were constrained and
-        invariant-checked under exactly this config; resuming under any
-        other would be silently unsound."""
-        key = repr((self.config.bounds, self.config.spec,
-                    self.config.invariants, self.config.chunk,
-                    self.caps)).encode()
-        return int.from_bytes(hashlib.sha256(key).digest()[:8], "big")
-
-    def save_checkpoint(self, path: str, carry: Carry) -> None:
-        """Snapshot the carry to ``path`` (.npz), atomically."""
+    def save_checkpoint(self, path: str, carry: Carry,
+                        init_key: tuple) -> None:
+        """Snapshot the carry to ``path`` (.npz), atomically.  The digest
+        pins the full model identity (bounds/spec/invariants/symmetry/
+        chunk/capacities) AND the initial state's dedup key, so a resume
+        under a different config or a different ``init_override`` fails
+        loudly (utils/ckpt.py)."""
         host = jax.device_get(carry)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:      # file handle: savez adds no suffix
-            np.savez(f, **{f"c{i}": np.asarray(x)
-                           for i, x in enumerate(host)},
-                     config_digest=np.uint64(self._ckpt_digest()),
-                     width=np.int64(self.lay.width))
-        os.replace(tmp, path)
+        ckpt.atomic_savez(
+            path,
+            **{f"c{i}": np.asarray(x) for i, x in enumerate(host)},
+            config_digest=np.uint64(
+                ckpt.config_digest(self.config, self.caps, init_key)),
+            width=np.int64(self.lay.width))
 
-    def load_checkpoint(self, path: str) -> Carry:
-        """Load a carry saved by :meth:`save_checkpoint`; the checkpoint's
-        full model identity (bounds, spec subset, invariants, chunk,
-        capacities) must match this engine's."""
-        with np.load(path) as z:
-            if int(z["config_digest"]) != self._ckpt_digest():
-                raise ValueError(
-                    "checkpoint was written under a different model config "
-                    "(bounds/spec/invariants/chunk/capacities digest "
-                    "mismatch); resuming it here would be unsound")
+    def load_checkpoint(self, path: str, init_key: tuple) -> Carry:
+        """Load a carry saved by :meth:`save_checkpoint` (digest-checked)."""
+        with ckpt.load_npz_checked(
+                path, ckpt.config_digest(self.config, self.caps,
+                                         init_key)) as z:
             arrs = [z[f"c{i}"] for i in range(len(Carry._fields))]
         carry = Carry(*(jnp.asarray(a) for a in arrs))
         if self.device is not None:
@@ -505,7 +496,7 @@ class DeviceEngine:
                 jnp.bool_(interp.constraint_ok(init_py, bounds)))
         if self.device is not None:
             args = jax.device_put(args, self.device)
-        carry = self.load_checkpoint(resume) if resume \
+        carry = self.load_checkpoint(resume, (hi0, lo0)) if resume \
             else self._init(*args)
         # Segment loop: each dispatch runs <= budget chunk expansions on
         # device, then the host syncs on one scalar.  Buffers are donated, so
@@ -525,7 +516,7 @@ class DeviceEngine:
                 break
             if checkpoint and (time.monotonic() - last_ckpt
                                >= checkpoint_every_s):
-                self.save_checkpoint(checkpoint, carry)
+                self.save_checkpoint(checkpoint, carry, (hi0, lo0))
                 last_ckpt = time.monotonic()
             dt = time.monotonic() - t_seg
             if not first and dt > 0.05:

@@ -49,6 +49,7 @@ from raft_tla_tpu.ops import fingerprint as fpr
 from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym_mod
+from raft_tla_tpu.utils import ckpt
 from raft_tla_tpu.utils import native
 
 I32 = jnp.int32
@@ -270,10 +271,59 @@ class PagedEngine:
             paged += n
         return paged
 
+    # -- checkpoint / resume --------------------------------------------
+    # A paged checkpoint is the device carry plus the host store's row and
+    # link logs; resume is bit-exact (the search is a pure function of
+    # both).  Needed in anger: the deployment tunnel's chip can be
+    # preempted mid-run (the worker dies silently, the client hangs), so
+    # long exhaustive runs are driven as checkpoint → rerun → resume.
+
+    def save_checkpoint(self, path: str, carry: Carry, host, paged: int,
+                        init_key: tuple) -> None:
+        """Snapshot carry + host store.  The store's row/link logs stream
+        to ``path + ".rows"``/``".links"`` in bounded blocks (never a
+        second full copy in RAM); the metadata npz with the ``paged``
+        counter is written LAST, so a crash between files leaves an older
+        counter next to longer streams — safe, because the store is
+        append-only and prefixes are stable (utils/ckpt.py)."""
+        ckpt.stream_rows_out(path + ".rows", host.read, paged,
+                             self.schema.P)
+
+        def links_reader(start, n):
+            par, lan = host.read_links(start, n)
+            return np.stack([par, lan], axis=1)
+
+        ckpt.stream_rows_out(path + ".links", links_reader, paged, 2)
+        arrs = jax.device_get(carry)
+        ckpt.atomic_savez(
+            path,
+            **{f"c{i}": np.asarray(x) for i, x in enumerate(arrs)},
+            paged=np.int64(paged),
+            config_digest=np.uint64(
+                ckpt.config_digest(self.config, self.caps, init_key)))
+
+    def load_checkpoint(self, path: str, init_key: tuple):
+        """Returns ``(carry, host, paged)`` restored from ``path``."""
+        with ckpt.load_npz_checked(
+                path, ckpt.config_digest(self.config, self.caps,
+                                         init_key)) as z:
+            carry = Carry(*(jnp.asarray(z[f"c{i}"])
+                            for i in range(len(Carry._fields))))
+            paged = int(z["paged"])
+        host = native.make_store(self.schema.P)
+        ckpt.stream_rows_in(path + ".rows", host.append, paged)
+        ckpt.stream_rows_in(
+            path + ".links",
+            lambda blk: host.append_links(blk[:, 0], blk[:, 1]), paged)
+        return carry, host, paged
+
     def check(self, init_override: interp.PyState | None = None,
-              on_progress=None) -> EngineResult:
+              on_progress=None, checkpoint: str | None = None,
+              checkpoint_every_s: float = 300.0,
+              resume: str | None = None) -> EngineResult:
         """``on_progress`` as in DeviceEngine.check: structured per-segment
-        run stats (SURVEY §5)."""
+        run stats (SURVEY §5).  ``checkpoint``/``resume`` as in
+        DeviceEngine, additionally snapshotting the host store."""
         t0 = time.monotonic()
         bounds = self.bounds
         init_py = init_override if init_override is not None \
@@ -290,15 +340,20 @@ class PagedEngine:
                     violation=Violation(nm, init_py, [(None, init_py)]),
                     levels=[1], wall_s=time.monotonic() - t0)
 
-        host = native.make_store(self.schema.P)
-        init_packed = self.schema.pack(init_vec.astype(np.int32), np)
-        carry = self._init(jnp.asarray(init_packed, I32), jnp.uint32(hi0),
-                           jnp.uint32(lo0),
-                           jnp.bool_(interp.constraint_ok(init_py, bounds)))
+        if resume:
+            carry, host, paged = self.load_checkpoint(resume, (hi0, lo0))
+        else:
+            host = native.make_store(self.schema.P)
+            init_packed = self.schema.pack(init_vec.astype(np.int32), np)
+            carry = self._init(
+                jnp.asarray(init_packed, I32), jnp.uint32(hi0),
+                jnp.uint32(lo0),
+                jnp.bool_(interp.constraint_ok(init_py, bounds)))
+            paged = 0
         budget = max(1, self.seg_chunks)
-        paged = 0
         first = True
         worst_s_per_chunk = 0.0
+        last_ckpt = time.monotonic()
         while True:
             # Pause the device loop before unpaged rows could be overwritten:
             # rows < pause_at are safe while n_states - lvl_start <= ring.
@@ -312,6 +367,11 @@ class PagedEngine:
                 on_progress(_progress_stats(carry, t0))
             if bool(done):
                 break
+            if checkpoint and (time.monotonic() - last_ckpt
+                               >= checkpoint_every_s):
+                self.save_checkpoint(checkpoint, carry, host, paged,
+                                     (hi0, lo0))
+                last_ckpt = time.monotonic()
             dt = time.monotonic() - t_seg
             if not first and dt > 0.05:
                 worst_s_per_chunk = max(worst_s_per_chunk, dt / budget)

@@ -1,0 +1,91 @@
+"""Shared checkpoint machinery for the engines (TLC ``-recover`` analog).
+
+One definition site for the soundness-critical parts so the engines cannot
+drift (a review round caught the device engine's digest missing
+``symmetry`` while the paged engine's had it):
+
+- :func:`config_digest` — pins the full model identity (bounds, spec
+  subset, invariants, **symmetry**, chunk, capacities) *and the initial
+  state's dedup key*, so a checkpoint can be resumed neither under a
+  different model nor from a different root (``init_override`` differences
+  are caught, not silently discarded).
+- :func:`atomic_savez` / :func:`load_npz_checked` — tmp + ``os.replace``
+  atomic npz with the digest check.
+- :func:`stream_rows_out` / :func:`stream_rows_in` — raw int32 row blocks
+  streamed in bounded chunks, so snapshotting a multi-GB host store never
+  materializes a second full copy in RAM.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+_STREAM_ROWS = 1 << 20      # rows per streamed block
+
+
+def config_digest(config, caps, init_key: tuple) -> int:
+    key = repr((config.bounds, config.spec, config.invariants,
+                config.symmetry, config.chunk, caps, init_key)).encode()
+    return int.from_bytes(hashlib.sha256(key).digest()[:8], "big")
+
+
+def atomic_savez(path: str, **arrays) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:      # file handle: savez adds no suffix
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+
+
+def load_npz_checked(path: str, digest: int):
+    """Returns the opened NpzFile; raises if the digest does not match."""
+    z = np.load(path)
+    if int(z["config_digest"]) != digest:
+        z.close()
+        raise ValueError(
+            "checkpoint was written under a different model config or "
+            "initial state (digest mismatch); resuming it here would be "
+            "unsound")
+    return z
+
+
+def stream_rows_out(path: str, reader, n_rows: int, width: int) -> None:
+    """Write ``n_rows`` int32 rows to ``path`` via ``reader(start, n)``,
+    never holding more than one block in memory.  Atomic."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.array([n_rows, width], np.int64).tofile(f)
+        start = 0
+        while start < n_rows:
+            n = min(_STREAM_ROWS, n_rows - start)
+            np.ascontiguousarray(reader(start, n), np.int32).tofile(f)
+            start += n
+    os.replace(tmp, path)
+
+
+def stream_rows_in(path: str, writer, limit: int) -> int:
+    """Feed the first ``limit`` rows of ``path`` through ``writer(block)``.
+
+    The stream may legitimately hold MORE rows than ``limit``: snapshots
+    write the (append-only, stable-prefix) streams before the metadata
+    npz, so a crash between the two leaves longer streams next to an older
+    ``paged`` counter — the excess is simply ignored.  Fewer rows than
+    ``limit`` means a genuinely torn snapshot and is an error.
+    """
+    with open(path, "rb") as f:
+        n_rows, width = (int(x) for x in np.fromfile(f, np.int64, 2))
+        if n_rows < limit:
+            raise ValueError(
+                f"checkpoint stream {path} holds {n_rows} rows, "
+                f"metadata expects {limit} — torn snapshot")
+        start = 0
+        while start < limit:
+            n = min(_STREAM_ROWS, limit - start)
+            block = np.fromfile(f, np.int32, n * width).reshape(n, width)
+            if block.shape[0] != n:
+                raise ValueError(f"truncated checkpoint stream {path}")
+            writer(block)
+            start += n
+    return limit

@@ -56,3 +56,31 @@ def test_checkpoint_file_is_atomic_npz(tmp_path):
         assert int(z["width"]) == eng.lay.width
         assert z["c0"].shape == (CAPS.n_states, eng.lay.width)
     assert not (tmp_path / "search.ckpt.tmp").exists()
+
+
+def test_paged_checkpoint_resume_bit_exact(tmp_path):
+    from raft_tla_tpu.paged_engine import PagedCapacities, PagedEngine
+    ckpt = str(tmp_path / "paged.ckpt")
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=16)
+    caps = PagedCapacities(ring=2048, table=1 << 13, levels=64)
+    eng = PagedEngine(cfg, caps, seg_chunks=8)
+    eng.SEG_MAX = 8
+    straight = eng.check()
+    eng2 = PagedEngine(cfg, caps, seg_chunks=8)
+    eng2.SEG_MAX = 8
+    eng2.check(checkpoint=ckpt, checkpoint_every_s=0.0)
+    eng3 = PagedEngine(cfg, caps, seg_chunks=8)
+    eng3.SEG_MAX = 8
+    resumed = eng3.check(resume=ckpt)
+    assert resumed.n_states == straight.n_states == 3014
+    assert resumed.levels == straight.levels
+    assert resumed.coverage == straight.coverage
+    assert resumed.n_transitions == straight.n_transitions
+
+    other = PagedEngine(cfg, PagedCapacities(ring=4096, table=1 << 13,
+                                             levels=64))
+    with pytest.raises(ValueError, match="checkpoint"):
+        other.check(resume=ckpt)
