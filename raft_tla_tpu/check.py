@@ -54,6 +54,15 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="CONSTRAINT: Cardinality(DOMAIN messages) <= N")
     p.add_argument("--max-dup", type=int, default=1,
                    help="CONSTRAINT: messages[m] <= N")
+    p.add_argument("--faithful", action="store_true",
+                   help="carry the proof-only history variables (elections/"
+                        "allLogs/voterLog/mlog, raft.tla:39,44,77) as real "
+                        "fingerprinted state, as stock TLC does on the "
+                        "unmodified spec; enables the *Hist invariants "
+                        "(default: parity mode, history stripped)")
+    p.add_argument("--max-elections", type=int, default=6,
+                   help="elections-history slot capacity (--faithful only); "
+                        "exceeding it aborts loudly")
     p.add_argument("--chunk", type=int, default=1024,
                    help="frontier states expanded per device step")
     p.add_argument("--cap", type=int, default=1 << 20,
@@ -63,6 +72,10 @@ def build_argparser() -> argparse.ArgumentParser:
                         "RAM-bounded")
     p.add_argument("--levels", type=int, default=256,
                    help="max BFS depth (device/shard engines)")
+    p.add_argument("--ring", type=int, default=None,
+                   help="HBM ring rows for --engine paged (power of two; "
+                        "must hold the widest current+next BFS level pair; "
+                        "default: derived from --cap, at most 4M)")
     p.add_argument("--devices", type=int, default=None,
                    help="mesh size for --engine shard (default: all)")
     p.add_argument("--cpu", action="store_true",
@@ -139,15 +152,25 @@ def _resolve_config(args):
             f"CONSTRAINT {cfg.constraints} not supported: the state "
             "constraint is the built-in bound, set via --max-* flags "
             "(emitted to TLC as 'StateConstraint')")
-    if cfg.view not in (None, "ParityView"):
+    if args.faithful:
+        # Faithful mode fingerprints FULL states; accepting a cfg that
+        # declares the history-stripping view would silently contradict
+        # what stock TLC does with that very cfg.
+        if cfg.view is not None:
+            raise ValueError(
+                f"VIEW {cfg.view} contradicts --faithful: faithful mode "
+                "fingerprints full states (no view); re-emit the TLC twin "
+                "with --faithful --emit-tlc")
+    elif cfg.view not in (None, "ParityView"):
         raise ValueError(
-            f"VIEW {cfg.view} not supported: states are always "
-            "fingerprinted under the built-in history-free ParityView")
+            f"VIEW {cfg.view} not supported: parity mode fingerprints "
+            "under the built-in history-free ParityView")
     bounds = Bounds(
         n_servers=len(cfg.server_names()),
         n_values=len(cfg.value_names()),
         max_term=args.max_term, max_log=args.max_log,
-        max_msgs=args.max_msgs, max_dup=args.max_dup)
+        max_msgs=args.max_msgs, max_dup=args.max_dup,
+        history=args.faithful, max_elections=args.max_elections)
     props = list(cfg.properties) + [nm for nm in args.property
                                      if nm not in cfg.properties]
     bad_props = [nm for nm in props if nm not in live_mod.PROPERTIES]
@@ -196,10 +219,15 @@ def _run(args, config):
         from raft_tla_tpu.paged_engine import PagedCapacities, PagedEngine
         A = len(S.action_table(config.bounds, config.spec))
         table = 1 << max(1, (2 * args.cap - 1).bit_length())
-        ring = 1 << min(22, max(12, (args.cap // 4).bit_length()))
+        if args.ring is not None:
+            # Explicit ring: pass through untouched — PagedEngine rejects
+            # undersized rings loudly (never silently resize, SURVEY §4.5).
+            ring = args.ring
+        else:
+            ring = max(1 << min(22, max(12, (args.cap // 4).bit_length())),
+                       1 << (2 * args.chunk * A - 1).bit_length())
         eng = PagedEngine(config, PagedCapacities(
-            ring=max(ring, 1 << (2 * args.chunk * A - 1).bit_length()),
-            table=table, levels=args.levels))
+            ring=ring, table=table, levels=args.levels))
         return eng.check(on_progress=_stats_cb(args),
                          checkpoint=args.checkpoint,
                          checkpoint_every_s=args.checkpoint_every,
@@ -243,6 +271,9 @@ def main(argv=None) -> int:
           f"(from {args.cfg})")
     print(f"Constraint: MaxTerm={b.max_term} MaxLogLen={b.max_log} "
           f"MaxMsgs={b.max_msgs} MaxDup={b.max_dup}")
+    if b.history:
+        print("Faithful mode: history variables (elections/allLogs/"
+              f"voterLog/mlog) carried; elections capacity {b.max_elections}")
     print(f"Invariants: {', '.join(config.invariants) or '(none)'}")
     if config.symmetry:
         print("Symmetry: Server permutations (counting orbits)")
@@ -252,6 +283,7 @@ def main(argv=None) -> int:
         try:
             tla, cfgp = tla_export.export(args.emit_tlc, b,
                                           config.invariants,
+                                          parity_view=not b.history,
                                           symmetry=bool(config.symmetry))
         except (OSError, ValueError) as e:
             print(f"Error: {e}", file=sys.stderr)

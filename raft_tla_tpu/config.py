@@ -42,6 +42,9 @@ _MAX_VALUES = 15        # 4-bit value field; values are 1..V (0 = none)
 # Multiplicities live in full int32 slots (never bit-packed); this cap only
 # keeps counts sane for host-side displays and catches runaway configs.
 _MAX_DUP_CAP = 1 << 20
+# Faithful mode: log ranks+1 must fit the 14-bit mlog field and the allLogs
+# bitmask must stay small (<= 32 int32 words).
+_MAX_LOG_UNIVERSE = 1024
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,6 +62,16 @@ class Bounds:
     max_log: int = 2       # constraint: \A i : Len(log[i]) <= max_log
     max_msgs: int = 4      # constraint: Cardinality(DOMAIN messages) <= max_msgs
     max_dup: int = 1       # constraint: \A m : messages[m] <= max_dup
+    # Faithful mode (SURVEY §7.0.3b): carry the proof-only history variables
+    # (elections raft.tla:39, allLogs raft.tla:44, voterLog raft.tla:77, and
+    # the mlog message fields raft.tla:220-222/297-299) as real fingerprinted
+    # state, exactly as stock TLC does on the unmodified spec.  Off (parity
+    # mode) they are stripped on both sides of every TLC comparison.
+    history: bool = False
+    # Capacity of the `elections` slot encoding.  The spec puts no bound on
+    # the set (it is derived-finite under the constraint); exceeding the
+    # capacity is a loud engine failure, never a clamp (SURVEY §4.5).
+    max_elections: int = 6
 
     def __post_init__(self) -> None:
         if not (1 <= self.n_servers <= _MAX_SERVERS):
@@ -73,6 +86,21 @@ class Bounds:
             raise ValueError(f"max_msgs must be >= 1, got {self.max_msgs}")
         if self.max_dup < 1 or self.dup_cap > _MAX_DUP_CAP:
             raise ValueError(f"max_dup out of range: {self.max_dup}")
+        if self.history:
+            if not (1 <= self.max_elections <= 64):
+                raise ValueError(
+                    f"max_elections must be in [1,64], got {self.max_elections}")
+            # Log-universe size gates the history encodings: ranks+1 must fit
+            # the 14-bit mlog message field (ops/msgbits.py) and the allLogs
+            # bitmask must stay a few dozen words (ops/loguniv.py).
+            from raft_tla_tpu.ops.loguniv import LogUniverse
+            uni = LogUniverse.of(self)
+            if uni.size > _MAX_LOG_UNIVERSE:
+                raise ValueError(
+                    f"faithful mode needs a log universe <= "
+                    f"{_MAX_LOG_UNIVERSE} (got {uni.size}: term_cap="
+                    f"{self.term_cap} x {self.n_values} values, lengths 0.."
+                    f"{self.log_cap}); shrink max_term/max_log/n_values")
 
     # -- capacities (representable range = one step past each bound) --------
     @property
@@ -111,3 +139,18 @@ class CheckConfig:
     symmetry: tuple = ()                   # () or ("Server",): TLC SYMMETRY
     chunk: int = 1024                      # frontier states expanded per jit call
     check_deadlock: bool = False           # TLC -deadlock analog (off: Restart is always enabled anyway)
+
+    def __post_init__(self) -> None:
+        if self.symmetry and self.bounds.history:
+            # The orbit fingerprint would have to permute server ids inside
+            # election records, voterLog tables and mlog-carrying messages;
+            # not implemented — reject rather than silently mis-quotient.
+            raise ValueError(
+                "SYMMETRY is not supported in faithful (history) mode")
+        if not self.bounds.history:
+            from raft_tla_tpu.models.invariants import HISTORY_REGISTRY
+            hist = [nm for nm in self.invariants if nm in HISTORY_REGISTRY]
+            if hist:
+                raise ValueError(
+                    f"invariant(s) {hist} read the history variables; they "
+                    "require faithful mode (Bounds.history / --faithful)")

@@ -85,6 +85,47 @@ def _py_leader_completeness(s, bounds: Bounds) -> bool:
     return True
 
 
+def _py_election_safety_hist(s, bounds: Bounds) -> bool:
+    """Election Safety over the ``elections`` history set (faithful mode):
+    at most one leader was *ever* elected per term (raft.tla:237-242) —
+    strictly stronger than the state-level reading, which only sees leaders
+    still in office."""
+    if s.elections is None:
+        return True
+    terms = {}
+    for (eterm, eleader, _elog, _evotes, _evlog) in s.elections:
+        if terms.setdefault(eterm, eleader) != eleader:
+            return False
+    return True
+
+
+def _py_leader_completeness_hist(s, bounds: Bounds) -> bool:
+    """Leader Completeness over history (Raft Fig. 3, the proof's reading):
+    every entry committed now is present in the ``elog`` of every recorded
+    election of a *later* term — including elections whose leader has since
+    crashed or been deposed, which the state-level check cannot see."""
+    if s.elections is None:
+        return True
+    for j in range(bounds.n_servers):
+        for k in range(s.commitIndex[j]):
+            ent = s.log[j][k]
+            for (eterm, _el, elog, _ev, _evl) in s.elections:
+                if eterm > s.term[j] and (len(elog) <= k or elog[k] != ent):
+                    return False
+    return True
+
+
+def _py_all_logs_prefix_closed(s, bounds: Bounds) -> bool:
+    """``allLogs`` is prefix-closed: logs grow by single appends
+    (``raft.tla:250, 383-388``) and every pre-state log is recorded
+    (``raft.tla:465``), so each log's parent prefix must already be in the
+    set.  A good self-check of the history machinery itself."""
+    if s.allLogs is None:
+        return True
+    seen = set(s.allLogs)
+    return all(l[:-1] in seen for l in s.allLogs if l)
+
+
 # -- jnp (device) predicates: struct -> scalar bool --------------------------
 
 def _jnp_election_safety(bounds: Bounds):
@@ -152,6 +193,59 @@ def _jnp_leader_completeness(bounds: Bounds):
     return inv
 
 
+def _jnp_election_safety_hist(bounds: Bounds):
+    import jax.numpy as jnp
+
+    def inv(st):
+        occ = st["eTerm"] > 0
+        both = occ[:, None] & occ[None, :]
+        same_term = st["eTerm"][:, None] == st["eTerm"][None, :]
+        diff_leader = st["eLeader"][:, None] != st["eLeader"][None, :]
+        return ~jnp.any(both & same_term & diff_leader)
+    return inv
+
+
+def _jnp_leader_completeness_hist(bounds: Bounds):
+    import jax.numpy as jnp
+    from raft_tla_tpu.ops.loguniv import LogUniverse
+    uni = LogUniverse.of(bounds)
+
+    def inv(st):
+        L = st["logTerm"].shape[1]
+        ks = jnp.arange(L)
+        committed = ks[None, :] < st["commitIndex"][:, None]      # [j, k]
+        et, ev, eln = uni.decode(st["eLog"], jnp)                 # [E, L], [E]
+        occ = st["eTerm"] > 0                                     # [E]
+        later = occ[:, None] & (st["eTerm"][:, None]
+                                > st["term"][None, :])            # [e, j]
+        long_enough = ks[None, :] < eln[:, None]                  # [e, k]
+        same = (et[:, None, :] == st["logTerm"][None, :, :]) \
+            & (ev[:, None, :] == st["logVal"][None, :, :])        # [e, j, k]
+        ok = long_enough[:, None, :] & same
+        must = later[:, :, None] & committed[None, :, :]
+        return ~jnp.any(must & ~ok)
+    return inv
+
+
+def _jnp_all_logs_prefix_closed(bounds: Bounds):
+    import jax.numpy as jnp
+    import numpy as np
+    from raft_tla_tpu.ops.loguniv import LogUniverse
+    uni = LogUniverse.of(bounds)
+    # Static tables over the whole (small) universe: rank -> parent rank.
+    rs = np.arange(uni.size)
+    parent = uni.prefix_id(rs, np)
+    nonempty = rs >= 1                       # rank 0 is the empty log
+
+    def inv(st):
+        mask = st["allLogs"]
+        present = (mask[rs // 32] >> (rs % 32)) & 1
+        par_present = (mask[parent // 32] >> (parent % 32)) & 1
+        bad = (present > 0) & jnp.asarray(nonempty) & (par_present == 0)
+        return ~jnp.any(bad)
+    return inv
+
+
 # name -> (python predicate, jnp predicate builder)
 REGISTRY = {
     # The reference cfg's undefined operator, defined (see module docstring).
@@ -163,6 +257,17 @@ REGISTRY = {
     "CommittedWithinLog": (_py_committed_within_log, _jnp_committed_within_log),
     "LeaderCompleteness": (_py_leader_completeness, _jnp_leader_completeness),
 }
+
+# History-based invariants: need the faithful-mode encodings (Bounds.history).
+HISTORY_REGISTRY = {
+    "ElectionSafetyHist": (_py_election_safety_hist,
+                           _jnp_election_safety_hist),
+    "LeaderCompletenessHist": (_py_leader_completeness_hist,
+                               _jnp_leader_completeness_hist),
+    "AllLogsPrefixClosed": (_py_all_logs_prefix_closed,
+                            _jnp_all_logs_prefix_closed),
+}
+REGISTRY.update(HISTORY_REGISTRY)
 
 
 def py_invariant(name: str):

@@ -79,6 +79,26 @@ LeaderCompleteness ==
         \\A k \\in 1..commitIndex[j] :
             (state[i] = Leader /\\ currentTerm[i] > currentTerm[j]) =>
                 (k <= Len(log[i]) /\\ log[i][k] = log[j][k])""",
+    # -- history-based (faithful mode: read the raft.tla:39/44 variables) ----
+    "ElectionSafetyHist": """\
+\\* At most one leader was EVER elected per term (over the elections
+\\* history, raft.tla:237-242) — stronger than the state-level reading.
+ElectionSafetyHist ==
+    \\A e1, e2 \\in elections : e1.eterm = e2.eterm => e1.eleader = e2.eleader""",
+    "LeaderCompletenessHist": """\
+\\* Every currently-committed entry appears in the elog of every recorded
+\\* election of a later term (Raft Fig. 3 over history).
+LeaderCompletenessHist ==
+    \\A j \\in Server :
+        \\A k \\in 1..commitIndex[j] :
+            \\A e \\in elections :
+                e.eterm > currentTerm[j] =>
+                    (k <= Len(e.elog) /\\ e.elog[k] = log[j][k])""",
+    "AllLogsPrefixClosed": """\
+\\* allLogs (raft.tla:44,465) is prefix-closed: logs grow by single appends.
+AllLogsPrefixClosed ==
+    \\A l \\in allLogs :
+        Len(l) > 0 => SubSeq(l, 1, Len(l) - 1) \\in allLogs""",
 }
 
 _PARITY_VIEW = """\

@@ -39,8 +39,12 @@ def field_bits(bounds: Bounds) -> dict:
     """Per-element bit width for every Layout field (pack() order)."""
     n = bounds.n_servers
     hi_bits = max(sh + w for sh, w in _HI_FIELDS.values())
-    lo_bits = max(sh + w for sh, w in _LO_FIELDS.values())
-    return {
+    # Parity mode never sets the mlog field 'g' (always 0): pack only the
+    # bits below it, so parity rows don't widen with the faithful schema.
+    lo_fields = _LO_FIELDS if bounds.history else \
+        {k: v for k, v in _LO_FIELDS.items() if k != "g"}
+    lo_bits = max(sh + w for sh, w in lo_fields.values())
+    out = {
         "role": _bits(2),
         "term": _bits(bounds.term_cap),
         "votedFor": _bits(n),                    # 0 = Nil, else id+1
@@ -53,9 +57,22 @@ def field_bits(bounds: Bounds) -> dict:
         "nextIndex": _bits(bounds.log_cap + 1),  # 1..Len(log)+1
         "matchIndex": _bits(bounds.log_cap),
         "msgHi": hi_bits,                        # 29: the packed record word
-        "msgLo": lo_bits,                        # 17
+        "msgLo": lo_bits,                        # the packed record word
         "msgCount": _bits(bounds.dup_cap),
     }
+    if bounds.history:
+        from raft_tla_tpu.ops.loguniv import LogUniverse
+        uni = LogUniverse.of(bounds)
+        out.update({
+            "allLogs": 32,                       # raw bitmask words
+            "vLog": uni.id_bits,                 # rank+1, 0 = absent
+            "eTerm": _bits(bounds.term_cap),
+            "eLeader": _bits(max(n - 1, 1)),
+            "eLog": uni.id_bits,
+            "eVotes": n,                         # evotes server bitmask
+            "eVLog": uni.id_bits,                # rank+1, 0 = absent
+        })
+    return out
 
 
 class BitSchema:
@@ -65,7 +82,7 @@ class BitSchema:
         lay = st.Layout.of(bounds)
         fb = field_bits(bounds)
         bits = []
-        for f in st.STATE_FIELDS:
+        for f in lay.fields:
             bits += [fb[f]] * int(np.prod(lay.shapes[f]))
         self.bits = np.asarray(bits, np.int64)          # [W]
         self.start = np.concatenate(([0], np.cumsum(self.bits)[:-1]))

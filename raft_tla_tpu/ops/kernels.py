@@ -39,11 +39,18 @@ import numpy as np
 
 from raft_tla_tpu.config import Bounds
 from raft_tla_tpu.models import spec as SP
+from raft_tla_tpu.ops import loguniv
 from raft_tla_tpu.ops import msgbits as mb
 from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import fingerprint as fpr
 
 I32 = jnp.int32
+
+
+def _log_rank(bounds, s, i):
+    """Rank of ``log[i]`` in the bounded log universe (faithful mode)."""
+    uni = loguniv.LogUniverse.of(bounds)
+    return uni.log_id(s["logTerm"][i], s["logVal"][i], s["logLen"][i], jnp)
 
 
 def _popcount(x):
@@ -82,20 +89,33 @@ def _last_term(s, i):
 
 # -- bag operations (raft.tla:106-130) ---------------------------------------
 
+def _slot_insert(match, empty):
+    """Fixed-shape set/bag insert plan over slot arrays.
+
+    Given exclusive masks for "element already in a slot" and "slot free",
+    returns ``(ins, exists, overflow)``: the one-hot first-free-slot mask to
+    write into (all-False when the element exists or nothing is free),
+    whether it already exists, and whether insertion was impossible.
+    Shared by the message bag and the faithful-mode elections set so the
+    soundness-sensitive idiom has one definition site.
+    """
+    exists = jnp.any(match)
+    has_empty = jnp.any(empty)
+    ins = (~exists) & has_empty & _onehot(jnp.argmax(empty),
+                                          empty.shape[0]) & empty
+    return ins, exists, (~exists) & (~has_empty)
+
+
 def bag_add(s, hi, lo):
     """``WithMessage`` (raft.tla:106-110). Returns (struct', overflow)."""
     H, L, C = s["msgHi"], s["msgLo"], s["msgCount"]
     match = (H == hi) & (L == lo) & (C > 0)
-    exists = jnp.any(match)
-    empty = C == 0
-    has_empty = jnp.any(empty)
-    first_empty = jnp.argmax(empty)  # index of first empty slot
-    ins = (~exists) & has_empty & _onehot(first_empty, C.shape[0]) & empty
+    ins, _exists, ovf = _slot_insert(match, C == 0)
     out = dict(s)
     out["msgHi"] = jnp.where(ins, hi, H).astype(I32)
     out["msgLo"] = jnp.where(ins, lo, L).astype(I32)
     out["msgCount"] = (C + match.astype(I32) + ins.astype(I32)).astype(I32)
-    return out, (~exists) & (~has_empty)
+    return out, ovf
 
 
 def bag_remove(s, hi, lo):
@@ -142,6 +162,8 @@ def k_restart(bounds, s, i):
     out["nextIndex"] = _set_row(s["nextIndex"], i, 1)
     out["matchIndex"] = _set_row(s["matchIndex"], i, 0)
     out["commitIndex"] = _set1(s["commitIndex"], i, 0)
+    if "vLog" in s:   # voterLog[i] := empty map (raft.tla:171)
+        out["vLog"] = _set_row(s["vLog"], i, 0)
     return out, jnp.bool_(True), jnp.bool_(False)
 
 
@@ -154,6 +176,8 @@ def k_timeout(bounds, s, i):
     out["votedFor"] = _set1(s["votedFor"], i, SP.NIL)
     out["vResp"] = _set1(s["vResp"], i, 0)
     out["vGrant"] = _set1(s["vGrant"], i, 0)
+    if "vLog" in s:   # voterLog[i] := empty map (raft.tla:186)
+        out["vLog"] = _set_row(s["vLog"], i, 0)
     return out, valid, jnp.bool_(False)
 
 
@@ -178,15 +202,21 @@ def k_append_entries(bounds, s, i, j):
     eidx = jnp.clip(ni - 1, 0, Lcap - 1)
     ent_term = jnp.where(has_ent, s["logTerm"][i, eidx], 0)
     ent_val = jnp.where(has_ent, s["logVal"][i, eidx], 0)
+    mlog = _log_rank(bounds, s, i) if "allLogs" in s else 0  # raft.tla:220-222
     hi, lo = mb.ae_request(
         s["term"][i], prev_idx, prev_term, has_ent.astype(I32), ent_term,
-        ent_val, jnp.minimum(s["commitIndex"][i], last_entry), i, j)
+        ent_val, jnp.minimum(s["commitIndex"][i], last_entry), i, j, mlog)
     out, ovf = bag_add(s, hi, lo)
     return out, valid, valid & ovf
 
 
 def k_become_leader(bounds, s, i):
-    """``BecomeLeader(i)`` (raft.tla:229-243); Quorum as popcount."""
+    """``BecomeLeader(i)`` (raft.tla:229-243); Quorum as popcount.
+
+    In faithful mode also inserts [eterm, eleader, elog, evotes, evoterLog]
+    into the ``elections`` slot set (raft.tla:237-242) — a set insert like
+    ``bag_add``, minus multiplicities; slot exhaustion is a loud overflow.
+    """
     n = bounds.n_servers
     valid = ((s["role"][i] == SP.CANDIDATE)
              & (2 * _popcount(s["vGrant"][i]) > n))
@@ -194,7 +224,23 @@ def k_become_leader(bounds, s, i):
     out["role"] = _set1(s["role"], i, SP.LEADER)
     out["nextIndex"] = _set_row(s["nextIndex"], i, s["logLen"][i] + 1)
     out["matchIndex"] = _set_row(s["matchIndex"], i, 0)
-    return out, valid, jnp.bool_(False)
+    ovf = jnp.bool_(False)
+    if "eTerm" in s:
+        lid = _log_rank(bounds, s, i)
+        vrow = s["vLog"][i]
+        occ = s["eTerm"] > 0
+        match = (occ & (s["eTerm"] == s["term"][i]) & (s["eLeader"] == i)
+                 & (s["eLog"] == lid) & (s["eVotes"] == s["vGrant"][i])
+                 & jnp.all(s["eVLog"] == vrow[None, :], axis=1))
+        ins, _exists, ovf = _slot_insert(match, ~occ)
+        out["eTerm"] = jnp.where(ins, s["term"][i], s["eTerm"]).astype(I32)
+        out["eLeader"] = jnp.where(ins, i, s["eLeader"]).astype(I32)
+        out["eLog"] = jnp.where(ins, lid, s["eLog"]).astype(I32)
+        out["eVotes"] = jnp.where(ins, s["vGrant"][i],
+                                  s["eVotes"]).astype(I32)
+        out["eVLog"] = jnp.where(ins[:, None], vrow[None, :],
+                                 s["eVLog"]).astype(I32)
+    return out, valid, valid & ovf
 
 
 def k_client_request(bounds, s, i, v):
@@ -266,7 +312,8 @@ def k_receive(bounds, s, slot):
     grant = ((mt == ct) & log_ok_rv
              & ((s["votedFor"][i] == SP.NIL)
                 | (s["votedFor"][i] == j + 1)))               # raft.tla:288-290
-    resp_hi, resp_lo = mb.rv_response(ct, grant.astype(I32), i, j)
+    my_mlog = _log_rank(bounds, s, i) if "allLogs" in s else 0  # :297-299
+    resp_hi, resp_lo = mb.rv_response(ct, grant.astype(I32), i, j, my_mlog)
     s_rvreq = dict(s)
     s_rvreq["votedFor"] = jnp.where(
         grant, _set1(s["votedFor"], i, j + 1), s["votedFor"])  # raft.tla:292
@@ -282,6 +329,11 @@ def k_receive(bounds, s, slot):
     s_rvresp["vGrant"] = jnp.where(
         mb.fa(hi) > 0,
         _set1(s["vGrant"], i, s["vGrant"][i] | (1 << j)), s["vGrant"])
+    if "vLog" in s:
+        # voterLog[i] @@ (j :> m.mlog): existing entry wins (raft.tla:316-317)
+        cur = s["vLog"][i, j]
+        newv = jnp.where((mb.fa(hi) > 0) & (cur == 0), mb.fg(lo) + 1, cur)
+        s_rvresp["vLog"] = _set2(s["vLog"], i, j, newv)
     s_rvresp = bag_remove(s_rvresp, hi, lo)
 
     # HandleAppendEntriesRequest (raft.tla:327-389)
@@ -431,6 +483,23 @@ def build_expand(bounds: Bounds, spec: str = "full"):
             ovfs.append(jnp.broadcast_to(ovf, (len(instances),)))
         all_succs = jax.tree.map(
             lambda *xs: jnp.concatenate(xs, axis=0), *succs)
+        if "allLogs" in s:
+            # allLogs' = allLogs \cup {log[i] : i \in Server}, conjoined
+            # with the UNPRIMED logs onto every disjunct (raft.tla:464-465)
+            # — one shared update broadcast across all successor lanes.
+            uni = loguniv.LogUniverse.of(bounds)
+            Wa = s["allLogs"].shape[0]
+            ids = uni.log_id(s["logTerm"], s["logVal"], s["logLen"], jnp)
+            word, bit = ids // 32, ids % 32
+            shift = jnp.left_shift(jnp.int32(1), bit)           # [n]
+            masks = jnp.where(jnp.arange(Wa)[None, :] == word[:, None],
+                              shift[:, None], 0)                # [n, Wa]
+            delta = masks[0]
+            for t in range(1, masks.shape[0]):
+                delta = delta | masks[t]
+            new_all = (s["allLogs"] | delta).astype(I32)
+            A = all_succs["allLogs"].shape[0]
+            all_succs["allLogs"] = jnp.broadcast_to(new_all, (A, Wa))
         all_succs = jax.vmap(lambda t: st.canonicalize(t, jnp))(all_succs)
         return all_succs, jnp.concatenate(valids), jnp.concatenate(ovfs)
 

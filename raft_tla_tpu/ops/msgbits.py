@@ -13,10 +13,12 @@ same bag element iff their ``(hi, lo)`` words are equal, and canonical state
 ordering sorts slots by ``(hi, lo)`` — so packing *is* the equality and order
 structure of the bag.
 
-Parity-mode note: the ``mlog`` fields (``raft.tla:220-222`` and
-``raft.tla:297-299``) are proof-only history data read by no guard; they are
-stripped here, exactly as they are stripped from the derived history-free spec
-that the TLC oracle runs (models/tla_export.py, SURVEY §7.0.3).
+The ``mlog`` fields (``raft.tla:220-222`` and ``raft.tla:297-299``) are
+proof-only history data: in parity mode they are stripped (field ``g`` = 0),
+exactly as they are stripped from the derived history-free spec that the TLC
+oracle runs (models/tla_export.py, SURVEY §7.0.3); in faithful mode they are
+carried as log-universe ranks (ops/loguniv.py) and join message identity, as
+in stock TLC on the unmodified spec.
 
 =========  =============================  =====================================
 field      bits (word@shift)              meaning by mtype
@@ -36,6 +38,10 @@ c          1  (lo@0)                      AEReq: Len(mentries), 0|1 (:212-214)
 d          6  (lo@1)                      AEReq: mentries[1].term
 e          4  (lo@7)                      AEReq: mentries[1].value
 f          6  (lo@11)                     AEReq: mcommitIndex (:223)
+g          14 (lo@17)                     faithful mode only: ``mlog`` as a
+                                          log-universe rank (ops/loguniv.py)
+                                          AEReq :220-222, RVResp :297-299;
+                                          0 in parity mode (stripped)
 =========  =============================  =====================================
 
 All helpers are plain shift/mask arithmetic, so they work identically on
@@ -48,7 +54,8 @@ from __future__ import annotations
 # (shift, width) per field
 _HI_FIELDS = {"mtype": (0, 3), "mterm": (3, 6), "a": (9, 6), "b": (15, 6),
               "src": (21, 4), "dst": (25, 4)}
-_LO_FIELDS = {"c": (0, 1), "d": (1, 6), "e": (7, 4), "f": (11, 6)}
+_LO_FIELDS = {"c": (0, 1), "d": (1, 6), "e": (7, 4), "f": (11, 6),
+              "g": (17, 14)}
 
 
 def pack_hi(mtype, mterm, a, b, src, dst):
@@ -56,8 +63,8 @@ def pack_hi(mtype, mterm, a, b, src, dst):
             | (src << 21) | (dst << 25))
 
 
-def pack_lo(c, d, e, f):
-    return c | (d << 1) | (e << 7) | (f << 11)
+def pack_lo(c, d, e, f, g=0):
+    return c | (d << 1) | (e << 7) | (f << 11) | (g << 17)
 
 
 def _get(word, shift, width):
@@ -104,6 +111,11 @@ def ff(lo):
     return _get(lo, *_LO_FIELDS["f"])
 
 
+def fg(lo):
+    """``mlog`` as a log-universe rank (faithful mode only; 0 in parity)."""
+    return _get(lo, *_LO_FIELDS["g"])
+
+
 # -- typed constructors (field meanings per record schema, see module doc) ---
 
 def rv_request(term, last_log_term, last_log_index, i, j):
@@ -111,16 +123,24 @@ def rv_request(term, last_log_term, last_log_index, i, j):
     return pack_hi(1, term, last_log_term, last_log_index, i, j), pack_lo(0, 0, 0, 0)
 
 
-def rv_response(term, granted, i, j):
-    """RequestVoteResponse record, mlog stripped (raft.tla:294-301)."""
-    return pack_hi(2, term, granted, 0, i, j), pack_lo(0, 0, 0, 0)
+def rv_response(term, granted, i, j, mlog=0):
+    """RequestVoteResponse record (raft.tla:294-301).
+
+    ``mlog`` — the voter's log as a universe rank (raft.tla:297-299) — is
+    carried only in faithful mode; parity mode passes 0 (stripped).
+    """
+    return pack_hi(2, term, granted, 0, i, j), pack_lo(0, 0, 0, 0, mlog)
 
 
 def ae_request(term, prev_idx, prev_term, n_entries, ent_term, ent_val,
-               commit, i, j):
-    """AppendEntriesRequest record, mlog stripped (raft.tla:215-225)."""
+               commit, i, j, mlog=0):
+    """AppendEntriesRequest record (raft.tla:215-225).
+
+    ``mlog`` — the leader's log as a universe rank (raft.tla:220-222) — is
+    carried only in faithful mode; parity mode passes 0 (stripped).
+    """
     return (pack_hi(3, term, prev_idx, prev_term, i, j),
-            pack_lo(n_entries, ent_term, ent_val, commit))
+            pack_lo(n_entries, ent_term, ent_val, commit, mlog))
 
 
 def ae_response(term, success, match_idx, i, j):

@@ -54,6 +54,19 @@ STATE_FIELDS = ("role", "term", "votedFor", "commitIndex", "logLen",
                 "logTerm", "logVal", "vResp", "vGrant",
                 "nextIndex", "matchIndex", "msgHi", "msgLo", "msgCount")
 
+# Faithful-mode extras (SURVEY §7.0.3b), appended after the parity fields so
+# parity-mode vectors are untouched.  Log-valued data is stored as ranks in
+# the bounded log universe (ops/loguniv.py):
+#   allLogs  (Wa,)   U-bit bitmask of log ranks        (raft.tla:44)
+#   vLog     (n, n)  voterLog[i][j] as rank+1, 0 = absent (raft.tla:77)
+#   eTerm    (E,)    elections slots (raft.tla:39); 0 = empty slot
+#   eLeader  (E,)    eleader (server id; 0 when slot empty)
+#   eLog     (E,)    elog as rank
+#   eVotes   (E,)    evotes as a server bitmask
+#   eVLog    (E, n)  evoterLog[j] as rank+1, 0 = absent
+HISTORY_FIELDS = ("allLogs", "vLog", "eTerm", "eLeader", "eLog",
+                  "eVotes", "eVLog")
+
 
 @dataclasses.dataclass(frozen=True)
 class Layout:
@@ -62,15 +75,27 @@ class Layout:
     n: int
     L: int
     S: int
+    E: int = 0       # elections slots (faithful mode; 0 = parity mode)
+    Wa: int = 0      # allLogs bitmask words
 
     @classmethod
     def of(cls, bounds: Bounds) -> "Layout":
-        return cls(n=bounds.n_servers, L=bounds.log_cap, S=bounds.msg_cap)
+        if not bounds.history:
+            return cls(n=bounds.n_servers, L=bounds.log_cap,
+                       S=bounds.msg_cap)
+        from raft_tla_tpu.ops.loguniv import LogUniverse
+        return cls(n=bounds.n_servers, L=bounds.log_cap, S=bounds.msg_cap,
+                   E=bounds.max_elections,
+                   Wa=LogUniverse.of(bounds).mask_words)
+
+    @property
+    def history(self) -> bool:
+        return self.E > 0
 
     @property
     def shapes(self) -> dict:
-        n, L, S = self.n, self.L, self.S
-        return {
+        n, L, S, E = self.n, self.L, self.S, self.E
+        out = {
             "role": (n,), "term": (n,), "votedFor": (n,),
             "commitIndex": (n,), "logLen": (n,),
             "logTerm": (n, L), "logVal": (n, L),
@@ -78,6 +103,17 @@ class Layout:
             "nextIndex": (n, n), "matchIndex": (n, n),
             "msgHi": (S,), "msgLo": (S,), "msgCount": (S,),
         }
+        if self.history:
+            out.update({
+                "allLogs": (self.Wa,), "vLog": (n, n),
+                "eTerm": (E,), "eLeader": (E,), "eLog": (E,),
+                "eVotes": (E,), "eVLog": (E, n),
+            })
+        return out
+
+    @property
+    def fields(self) -> tuple:
+        return STATE_FIELDS + (HISTORY_FIELDS if self.history else ())
 
     @property
     def width(self) -> int:
@@ -95,7 +131,7 @@ def init_struct(bounds: Bounds, xp):
     lay = Layout.of(bounds)
     n, L, S = lay.n, lay.L, lay.S
     i32 = xp.int32
-    return {
+    out = {
         "role": xp.full((n,), FOLLOWER, dtype=i32),
         "term": xp.ones((n,), dtype=i32),
         "votedFor": xp.full((n,), NIL, dtype=i32),
@@ -111,11 +147,26 @@ def init_struct(bounds: Bounds, xp):
         "msgLo": xp.zeros((S,), dtype=i32),
         "msgCount": xp.zeros((S,), dtype=i32),
     }
+    if lay.history:
+        # InitHistoryVars (raft.tla:140-142): elections = {}, allLogs = {},
+        # voterLog = per-server empty map.
+        n, E, Wa = lay.n, lay.E, lay.Wa
+        out.update({
+            "allLogs": xp.zeros((Wa,), dtype=i32),
+            "vLog": xp.zeros((n, n), dtype=i32),
+            "eTerm": xp.zeros((E,), dtype=i32),
+            "eLeader": xp.zeros((E,), dtype=i32),
+            "eLog": xp.zeros((E,), dtype=i32),
+            "eVotes": xp.zeros((E,), dtype=i32),
+            "eVLog": xp.zeros((E, n), dtype=i32),
+        })
+    return out
 
 
 def pack(struct, xp):
-    """Struct -> flat int32[W] vector (field order = STATE_FIELDS)."""
-    return xp.concatenate([xp.reshape(struct[f], (-1,)) for f in STATE_FIELDS])
+    """Struct -> flat int32[W] vector (field order = parity then history)."""
+    fields = STATE_FIELDS + (HISTORY_FIELDS if "allLogs" in struct else ())
+    return xp.concatenate([xp.reshape(struct[f], (-1,)) for f in fields])
 
 
 def unpack(vec, lay: Layout, xp):
@@ -149,6 +200,19 @@ def canonicalize(struct, xp):
     out["msgHi"] = hi[perm]
     out["msgLo"] = lo[perm]
     out["msgCount"] = ct[perm]
+    if "eTerm" in struct:
+        # elections is a set (raft.tla:39); slot order is an encoding
+        # artifact, canonicalized exactly like the message bag.  eTerm > 0
+        # marks occupancy (election terms start at 1, raft.tla:143).
+        eocc = struct["eTerm"] > 0
+        keys = (struct["eTerm"], struct["eLeader"], struct["eLog"],
+                struct["eVotes"]) + tuple(
+                    struct["eVLog"][:, c] for c in range(struct["eVLog"].shape[1]))
+        eperm = xp.lexsort(tuple(reversed(keys))
+                           + ((~eocc).astype(xp.int32),))
+        for f in ("eTerm", "eLeader", "eLog", "eVotes"):
+            out[f] = struct[f][eperm]
+        out["eVLog"] = struct["eVLog"][eperm]
     return out
 
 

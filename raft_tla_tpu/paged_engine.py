@@ -311,10 +311,12 @@ class PagedEngine:
                             for i in range(len(Carry._fields))))
             paged = int(z["paged"])
         host = native.make_store(self.schema.P)
-        ckpt.stream_rows_in(path + ".rows", host.append, paged)
+        ckpt.stream_rows_in(path + ".rows", host.append, paged,
+                            expect_width=self.schema.P)
         ckpt.stream_rows_in(
             path + ".links",
-            lambda blk: host.append_links(blk[:, 0], blk[:, 1]), paged)
+            lambda blk: host.append_links(blk[:, 0], blk[:, 1]), paged,
+            expect_width=2)
         return carry, host, paged
 
     def check(self, init_override: interp.PyState | None = None,

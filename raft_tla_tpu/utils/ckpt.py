@@ -65,7 +65,8 @@ def stream_rows_out(path: str, reader, n_rows: int, width: int) -> None:
     os.replace(tmp, path)
 
 
-def stream_rows_in(path: str, writer, limit: int) -> int:
+def stream_rows_in(path: str, writer, limit: int,
+                   expect_width: int | None = None) -> int:
     """Feed the first ``limit`` rows of ``path`` through ``writer(block)``.
 
     The stream may legitimately hold MORE rows than ``limit``: snapshots
@@ -73,9 +74,19 @@ def stream_rows_in(path: str, writer, limit: int) -> int:
     npz, so a crash between the two leaves longer streams next to an older
     ``paged`` counter — the excess is simply ignored.  Fewer rows than
     ``limit`` means a genuinely torn snapshot and is an error.
+
+    ``expect_width`` pins the caller's current row layout: the config
+    digest does not cover the bit-pack schema, so a checkpoint written
+    under an older packing must be rejected here, not resumed as silently
+    corrupted rows.
     """
     with open(path, "rb") as f:
         n_rows, width = (int(x) for x in np.fromfile(f, np.int64, 2))
+        if expect_width is not None and width != expect_width:
+            raise ValueError(
+                f"checkpoint stream {path} has row width {width}, this "
+                f"build expects {expect_width} — the packed-row layout "
+                "changed; the snapshot cannot be resumed")
         if n_rows < limit:
             raise ValueError(
                 f"checkpoint stream {path} holds {n_rows} rows, "

@@ -84,3 +84,17 @@ def test_paged_checkpoint_resume_bit_exact(tmp_path):
                                              levels=64))
     with pytest.raises(ValueError, match="checkpoint"):
         other.check(resume=ckpt)
+
+
+def test_stream_rows_width_mismatch_rejected(tmp_path):
+    """A packed-row layout change must refuse to resume old streams: the
+    config digest does not cover the bit-pack schema (review finding)."""
+    import numpy as np
+    from raft_tla_tpu.utils import ckpt
+    p = str(tmp_path / "s.rows")
+    ckpt.stream_rows_out(p, lambda st, n: np.zeros((n, 3), np.int32), 5, 3)
+    got = []
+    ckpt.stream_rows_in(p, got.append, 5, expect_width=3)
+    assert sum(b.shape[0] for b in got) == 5
+    with pytest.raises(ValueError, match="row width"):
+        ckpt.stream_rows_in(p, got.append, 5, expect_width=4)

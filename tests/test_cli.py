@@ -193,3 +193,45 @@ def test_cli_symmetry_flag(tmp_path):
     m = re.search(r"(\d+) distinct states found", out)
     assert m, out
     assert int(m.group(1)) == 1514          # orbits of the 3014-state space
+
+
+def test_cli_faithful_mode(tmp_path):
+    """--faithful carries history state; *Hist invariants resolve; the TLC
+    twin drops the ParityView (TLC fingerprints full states, as we do)."""
+    cfg = write_cfg(tmp_path / "h.cfg",
+                    extra="INVARIANTS ElectionSafetyHist "
+                          "AllLogsPrefixClosed\n")
+    out_tlc = tmp_path / "tlc"
+    code, out = run_cli(cfg, "--engine", "ref", "--faithful",
+                        "--max-term", "2", "--max-log", "1",
+                        "--max-msgs", "2", "--emit-tlc", str(out_tlc))
+    assert code == cli.EXIT_OK
+    assert "Faithful mode" in out
+    # 2s/1v full-spec faithful count (vs 48041-state... parity run is v=1:
+    # both pinned by refbfs in tests/test_history.py)
+    m = re.search(r"(\d+) distinct states found, diameter (\d+)", out)
+    assert m and int(m.group(2)) == 32
+    mod = open(out_tlc / "MCraft.tla").read()
+    assert "ElectionSafetyHist ==" in mod and "AllLogsPrefixClosed ==" in mod
+    assert "ParityView" not in mod
+    cfgp = parse_cfg(open(out_tlc / "MCraft.cfg").read())
+    assert cfgp.view is None
+    assert cfgp.constraints == ["StateConstraint"]
+
+
+def test_cli_faithful_required_for_hist_invariants(tmp_path):
+    cfg = write_cfg(tmp_path / "h2.cfg",
+                    extra="INVARIANT ElectionSafetyHist\n")
+    code, _out = run_cli(cfg, "--engine", "ref")
+    assert code == cli.EXIT_ERROR
+
+
+def test_cli_faithful_rejects_parity_view(tmp_path):
+    """A parity-emitted cfg (VIEW ParityView) contradicts --faithful."""
+    cfg = write_cfg(tmp_path / "v.cfg", extra="VIEW ParityView\n")
+    tiny = ("--spec", "election", "--max-term", "2", "--max-log", "0",
+            "--max-msgs", "1")
+    code, _ = run_cli(cfg, "--engine", "ref", *tiny)   # parity: accepted
+    assert code == cli.EXIT_OK
+    code, _ = run_cli(cfg, "--engine", "ref", "--faithful", *tiny)
+    assert code == cli.EXIT_ERROR

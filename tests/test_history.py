@@ -1,0 +1,177 @@
+"""Faithful mode (SURVEY §7.0.3b): history variables as real state.
+
+Covers the bounded-log universe (ops/loguniv.py), the history encodings in
+the tensor schema, lane-exact kernel/interpreter differentials with history
+on, engine parity, and the history-based invariants — including a seeded
+ElectionSafetyHist violation that only history can see (the state-level
+NoTwoLeaders reading holds while the history records two leaders for one
+term... which cannot happen in Raft, so the seeded case uses a doctored
+initial state).
+"""
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.models import interp, invariants as inv_mod, refbfs
+from raft_tla_tpu.ops import state as st
+from raft_tla_tpu.ops.loguniv import LogUniverse
+
+from test_state import random_pystate
+from test_kernels import _diff_on_states
+
+BH = Bounds(n_servers=2, n_values=2, max_term=2, max_log=1, max_msgs=2,
+            history=True, max_elections=4)
+
+
+def test_universe_roundtrip_and_prefix():
+    uni = LogUniverse.of(BH)
+    assert uni.size == 43            # R=6 (3 terms x 2 values), lengths 0..2
+    for r in range(uni.size):
+        t = uni.tuple_of_id(r)
+        assert uni.id_of_tuple(t) == r
+        if t:
+            assert uni.id_of_tuple(t[:-1]) == int(uni.prefix_id(np.asarray(r), np))
+    # empty log is rank 0 (parity-mode messages encode g = 0)
+    assert uni.id_of_tuple(()) == 0
+
+
+def test_universe_vectorized_matches_scalar():
+    uni = LogUniverse.of(BH)
+    rng = np.random.default_rng(7)
+    for _ in range(100):
+        ln = int(rng.integers(0, uni.L + 1))
+        log = tuple((int(rng.integers(1, uni.T + 1)),
+                     int(rng.integers(1, uni.V + 1))) for _ in range(ln))
+        lt = np.zeros(uni.L, np.int32)
+        lv = np.zeros(uni.L, np.int32)
+        for k, (t, v) in enumerate(log):
+            lt[k], lv[k] = t, v
+        assert int(uni.log_id(lt, lv, np.int32(ln), np)) == uni.id_of_tuple(log)
+        et, ev, eln = uni.decode(np.asarray(uni.id_of_tuple(log)), np)
+        assert int(eln) == ln
+        assert tuple((int(et[..., k]), int(ev[..., k]))
+                     for k in range(ln)) == log
+
+
+def test_layout_and_struct_roundtrip():
+    lay = st.Layout.of(BH)
+    assert lay.history and lay.E == 4 and lay.Wa == 2
+    rng = np.random.default_rng(3)
+    for _ in range(50):
+        s = random_pystate(rng, BH)
+        assert interp.from_struct(interp.to_struct(s, BH), BH) == s
+
+
+def test_config_gates():
+    with pytest.raises(ValueError, match="SYMMETRY"):
+        CheckConfig(bounds=BH, symmetry=("Server",))
+    with pytest.raises(ValueError, match="faithful"):
+        CheckConfig(invariants=("ElectionSafetyHist",))
+    with pytest.raises(ValueError, match="universe"):
+        Bounds(history=True, max_term=6, max_log=4, n_values=2)
+
+
+def test_differential_random_history_states():
+    rng = np.random.default_rng(11)
+    states = [random_pystate(rng, BH) for _ in range(48)]
+    _diff_on_states(states, BH)
+
+
+def test_differential_reachable_history_prefix():
+    cc = CheckConfig(bounds=BH, spec="full", invariants=())
+    frontier = [interp.init_state(BH)]
+    seen = set(frontier)
+    for _lvl in range(3):
+        nxt = []
+        for s in frontier:
+            for _ai, t in interp.successors(s, BH):
+                if t not in seen and interp.constraint_ok(s, BH):
+                    seen.add(t)
+                    nxt.append(t)
+        frontier = nxt[:64]
+    _diff_on_states(list(seen)[:128], BH)
+    assert cc.bounds.history
+
+
+def test_faithful_refines_parity_full_spec():
+    """History splits parity-equal states (e.g. post-crash states differing
+    only in what was ever elected); counts must only grow."""
+    bp = Bounds(n_servers=2, n_values=1, max_term=2, max_log=1, max_msgs=2)
+    bh = Bounds(n_servers=2, n_values=1, max_term=2, max_log=1, max_msgs=2,
+                history=True, max_elections=4)
+    rp = refbfs.check(CheckConfig(bounds=bp, spec="full",
+                                  invariants=("NoTwoLeaders",)))
+    rh = refbfs.check(CheckConfig(
+        bounds=bh, spec="full",
+        invariants=("NoTwoLeaders", "ElectionSafetyHist",
+                    "LeaderCompletenessHist", "AllLogsPrefixClosed")))
+    assert rh.violation is None
+    assert rh.n_states > rp.n_states        # 53398 vs 48041
+    assert rh.diameter == rp.diameter == 32
+
+
+def test_engine_parity_faithful():
+    """Device-path BFS (engine.py, per-chunk jit) must agree with the
+    interpreter BFS exactly in faithful mode."""
+    from raft_tla_tpu import engine
+    cc = CheckConfig(bounds=BH, spec="election",
+                     invariants=("NoTwoLeaders", "ElectionSafetyHist"),
+                     chunk=256)
+    r_ref = refbfs.check(cc)
+    r_eng = engine.check(cc)
+    assert (r_eng.n_states, r_eng.diameter) == (r_ref.n_states, r_ref.diameter)
+    assert r_eng.violation is None and r_ref.violation is None
+    assert r_eng.coverage == r_ref.coverage
+
+
+def test_election_safety_hist_seeded_violation():
+    """Two same-term elections with different leaders in the history: the
+    state-level NoTwoLeaders reading cannot see it (neither is in office),
+    but ElectionSafetyHist must flag it — on both predicate faces."""
+    n = BH.n_servers
+    s = interp.init_state(BH)
+    bad = s._replace(elections=tuple(sorted(
+        [(2, 0, (), 0b11, ((), ())), (2, 1, (), 0b11, ((), ()))],
+        key=interp._election_key)))
+    assert inv_mod.py_invariant("NoTwoLeaders")(bad, BH)
+    assert not inv_mod.py_invariant("ElectionSafetyHist")(bad, BH)
+    import jax.numpy as jnp
+    struct = {k: jnp.asarray(v) for k, v in interp.to_struct(bad, BH).items()}
+    assert not bool(inv_mod.jnp_invariant("ElectionSafetyHist", BH)(struct))
+    assert bool(inv_mod.jnp_invariant("LeaderCompletenessHist", BH)(struct))
+
+
+def test_all_logs_prefix_closed_seeded():
+    s = interp.init_state(BH)
+    # ((1,1),(1,2)) present without its prefix ((1,1),)
+    bad = s._replace(allLogs=tuple(sorted([(), ((1, 1), (1, 2))],
+                                          key=interp._log_key)))
+    ok = s._replace(allLogs=tuple(sorted([(), ((1, 1),), ((1, 1), (1, 2))],
+                                         key=interp._log_key)))
+    assert not inv_mod.py_invariant("AllLogsPrefixClosed")(bad, BH)
+    assert inv_mod.py_invariant("AllLogsPrefixClosed")(ok, BH)
+    import jax.numpy as jnp
+    for s_, want in ((bad, False), (ok, True)):
+        struct = {k: jnp.asarray(v)
+                  for k, v in interp.to_struct(s_, BH).items()}
+        assert bool(inv_mod.jnp_invariant("AllLogsPrefixClosed", BH)(struct)) \
+            is want
+
+
+def test_leader_completeness_hist_seeded_violation():
+    """A committed entry missing from a later-term election's elog."""
+    s = interp.init_state(BH)
+    ent = (1, 1)
+    bad = s._replace(
+        log=((ent,), ()), commitIndex=(1, 0), term=(1, 1),
+        elections=((2, 1, (), 0b11, ((), ())),))
+    assert not inv_mod.py_invariant("LeaderCompletenessHist")(bad, BH)
+    good = bad._replace(elections=((2, 1, (ent,), 0b11, ((), ())),))
+    assert inv_mod.py_invariant("LeaderCompletenessHist")(good, BH)
+    import jax.numpy as jnp
+    for s_, want in ((bad, False), (good, True)):
+        struct = {k: jnp.asarray(v)
+                  for k, v in interp.to_struct(s_, BH).items()}
+        assert bool(inv_mod.jnp_invariant(
+            "LeaderCompletenessHist", BH)(struct)) is want

@@ -33,8 +33,10 @@ def _diff_on_states(states, bounds, spec="full"):
     for bi, s in enumerate(states):
         # The +1 capacity scheme guarantees representability only one step
         # past the constraint: overflow must never fire on states the engine
-        # would actually expand (constraint-satisfying ones).
-        if interp.constraint_ok(s, bounds):
+        # would actually expand (constraint-satisfying ones).  Faithful mode
+        # is the exception: elections capacity is not constraint-governed
+        # (config.py), so its genuineness is checked per-lane below instead.
+        if interp.constraint_ok(s, bounds) and not bounds.history:
             assert not ovf[bi].any(), f"overflow on expandable state {s}"
         got_by_lane = {}
         for ai in range(len(table)):
@@ -45,10 +47,13 @@ def _diff_on_states(states, bounds, spec="full"):
         for ai in range(len(table)):
             if valid[bi, ai] and ovf[bi, ai]:
                 # Lane flagged unrepresentable: the interpreter successor must
-                # genuinely exceed tensor capacity (bag slots).
+                # genuinely exceed tensor capacity (bag, log, or — in
+                # faithful mode — elections slots).
                 t = want_by_lane.pop(ai)
                 assert len(t.msgs) > bounds.msg_cap or \
-                    any(len(l) > bounds.log_cap for l in t.log)
+                    any(len(l) > bounds.log_cap for l in t.log) or \
+                    (t.elections is not None
+                     and len(t.elections) > bounds.max_elections)
         assert set(got_by_lane) == set(want_by_lane), (
             f"state {bi}: enabled lanes differ\n"
             f"kernel-only: {[table[a].label() for a in set(got_by_lane) - set(want_by_lane)]}\n"

@@ -12,15 +12,45 @@ from raft_tla_tpu.ops import state as st
 B = Bounds(n_servers=3, n_values=2, max_term=3, max_log=2, max_msgs=4)
 
 
+def _random_log(rng, bounds: Bounds) -> tuple:
+    ln = rng.integers(0, bounds.log_cap + 1)
+    return tuple(
+        (int(rng.integers(1, bounds.term_cap + 1)),
+         int(rng.integers(1, bounds.n_values + 1))) for _ in range(ln))
+
+
 def random_pystate(rng, bounds: Bounds) -> interp.PyState:
     """Arbitrary bounded (not necessarily reachable) state, canonical."""
     n, V = bounds.n_servers, bounds.n_values
-    logs = []
-    for _ in range(n):
-        ln = rng.integers(0, bounds.log_cap + 1)
-        logs.append(tuple(
-            (int(rng.integers(1, bounds.term_cap + 1)),
-             int(rng.integers(1, V + 1))) for _ in range(ln)))
+    logs = [_random_log(rng, bounds) for _ in range(n)]
+    hist = {}
+
+    def rank(log):              # parity mode: mlog stripped (g = 0)
+        return 0
+    if bounds.history:
+        from raft_tla_tpu.ops.loguniv import LogUniverse
+        uni = LogUniverse.of(bounds)
+
+        def rank(log):          # noqa: F811 — faithful: mlog joins identity
+            return uni.id_of_tuple(log)
+        all_logs = {_random_log(rng, bounds)
+                    for _ in range(rng.integers(0, 5))}
+        vlog = tuple(tuple(
+            _random_log(rng, bounds) if rng.integers(0, 2) else None
+            for _j in range(n)) for _i in range(n))
+        recs = set()
+        for _ in range(rng.integers(0, bounds.max_elections + 1)):
+            recs.add((int(rng.integers(1, bounds.term_cap + 1)),
+                      int(rng.integers(0, n)),
+                      _random_log(rng, bounds),
+                      int(rng.integers(0, 2 ** n)),
+                      tuple(_random_log(rng, bounds)
+                            if rng.integers(0, 2) else None
+                            for _j in range(n))))
+        hist = dict(
+            allLogs=tuple(sorted(all_logs, key=interp._log_key)),
+            vLog=vlog,
+            elections=tuple(sorted(recs, key=interp._election_key)))
     msgs = {}
     for _ in range(rng.integers(0, bounds.msg_cap + 1)):
         mt = int(rng.integers(1, 5))
@@ -30,19 +60,22 @@ def random_pystate(rng, bounds: Bounds) -> interp.PyState:
             m = mb.rv_request(term, int(rng.integers(0, bounds.term_cap + 1)),
                               int(rng.integers(0, bounds.log_cap + 1)), i, j)
         elif mt == 2:
-            m = mb.rv_response(term, int(rng.integers(0, 2)), i, j)
+            m = mb.rv_response(term, int(rng.integers(0, 2)), i, j,
+                               rank(_random_log(rng, bounds)))
         elif mt == 3:
             ne = int(rng.integers(0, 2))
             m = mb.ae_request(term, int(rng.integers(0, bounds.log_cap + 1)),
                               int(rng.integers(0, bounds.term_cap + 1)),
                               ne, ne * int(rng.integers(1, bounds.term_cap + 1)),
                               ne * int(rng.integers(1, V + 1)),
-                              int(rng.integers(0, bounds.log_cap + 1)), i, j)
+                              int(rng.integers(0, bounds.log_cap + 1)), i, j,
+                              rank(_random_log(rng, bounds)))
         else:
             m = mb.ae_response(term, int(rng.integers(0, 2)),
                                int(rng.integers(0, bounds.log_cap + 1)), i, j)
         msgs[m] = int(rng.integers(1, bounds.dup_cap + 1))
     return interp.PyState(
+        **hist,
         role=tuple(int(x) for x in rng.integers(0, 3, n)),
         term=tuple(int(x) for x in rng.integers(1, bounds.term_cap + 1, n)),
         votedFor=tuple(int(x) for x in rng.integers(0, n + 1, n)),
