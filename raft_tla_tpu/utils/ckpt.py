@@ -18,6 +18,7 @@ drift (a review round caught the device engine's digest missing
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import os
 
@@ -26,9 +27,31 @@ import numpy as np
 _STREAM_ROWS = 1 << 20      # rows per streamed block
 
 
+def _stable(obj):
+    """Canonical digest form of a config dataclass: (name, value) pairs in
+    field order, OMITTING fields that sit at their declared default.
+
+    Hashing ``repr(obj)`` instead would orphan every existing checkpoint
+    each time a dataclass grows a new (defaulted) field — a lesson learned
+    when adding ``Bounds.history`` invalidated a 30M-state snapshot mid-run.
+    With default-valued fields excluded, old digests stay valid until a
+    semantically different value is actually used.
+    """
+    pairs = []
+    for f in dataclasses.fields(obj):
+        v = getattr(obj, f.name)
+        if f.default is not dataclasses.MISSING and v == f.default:
+            continue
+        if dataclasses.is_dataclass(v):
+            v = _stable(v)
+        pairs.append((f.name, v))
+    return (type(obj).__name__, tuple(pairs))
+
+
 def config_digest(config, caps, init_key: tuple) -> int:
-    key = repr((config.bounds, config.spec, config.invariants,
-                config.symmetry, config.chunk, caps, init_key)).encode()
+    key = repr((_stable(config.bounds), config.spec, config.invariants,
+                config.symmetry, config.chunk, _stable(caps),
+                init_key)).encode()
     return int.from_bytes(hashlib.sha256(key).digest()[:8], "big")
 
 
