@@ -26,6 +26,7 @@ import sys
 import time
 
 EXIT_OK = 0
+EXIT_DEADLOCK = 11       # TLC's exit code for deadlock
 EXIT_VIOLATION = 12      # TLC's exit code for safety-property violations
 EXIT_LIVENESS = 13       # TLC's exit code for liveness-property violations
 EXIT_ERROR = 1
@@ -54,6 +55,12 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="CONSTRAINT: Cardinality(DOMAIN messages) <= N")
     p.add_argument("--max-dup", type=int, default=1,
                    help="CONSTRAINT: messages[m] <= N")
+    p.add_argument("--deadlock", action="store_true",
+                   help="check for deadlocks (a reachable state with no "
+                        "successor) like stock TLC does by default; exit "
+                        "code 11 on one. Off by default: the full Next "
+                        "cannot deadlock (Restart is always enabled, "
+                        "raft.tla:167-175), only sub-specs can")
     p.add_argument("--faithful", action="store_true",
                    help="carry the proof-only history variables (elections/"
                         "allLogs/voterLog/mlog, raft.tla:39,44,77) as real "
@@ -180,7 +187,8 @@ def _resolve_config(args):
             f"{sorted(live_mod.PROPERTIES)}")
     return CheckConfig(bounds=bounds, spec=args.spec,
                        invariants=tuple(cfg.invariants), symmetry=symmetry,
-                       chunk=args.chunk), tuple(props)
+                       chunk=args.chunk,
+                       check_deadlock=args.deadlock), tuple(props)
 
 
 def _stats_cb(args):
@@ -312,12 +320,15 @@ def main(argv=None) -> int:
     if result.violation is None:
         print("Model checking completed. No error has been found.")
         return EXIT_OK
+    from raft_tla_tpu.engine import DEADLOCK
+    is_deadlock = result.violation.invariant == DEADLOCK
     if args.no_trace:
-        print(f"Error: Invariant {result.violation.invariant} is violated.")
+        print("Error: Deadlock reached." if is_deadlock else
+              f"Error: Invariant {result.violation.invariant} is violated.")
     else:
         from raft_tla_tpu.utils.render import render_trace
         print(render_trace(result.violation, b))
-    return EXIT_VIOLATION
+    return EXIT_DEADLOCK if is_deadlock else EXIT_VIOLATION
 
 
 def _check_liveness(args, config, props) -> int:

@@ -62,7 +62,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tla_tpu.config import CheckConfig
-from raft_tla_tpu.engine import EngineResult, Violation
+from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import fingerprint as fpr
 from raft_tla_tpu.ops import kernels
@@ -302,13 +302,26 @@ def _build_segment(config: CheckConfig, caps: Capacities, A: int, W: int):
             ~out["inv_ok"].reshape(B * A, n_inv), axis=-1) if n_inv \
             else jnp.zeros((B * A,), bool)
         first = jnp.min(jnp.where(inv_bad, jnp.arange(B * A, dtype=I32), BIG))
-        has_viol = first < BIG
-        new_viol = has_viol & (viol_g < 0)
-        viol_g = jnp.where(new_viol, pos[jnp.minimum(first, B * A - 1)],
-                           viol_g)
         bad_inv = jnp.argmax(
             ~out["inv_ok"].reshape(B * A, n_inv)
             [jnp.minimum(first, B * A - 1)]) if n_inv else jnp.int32(0)
+        g_target = pos[jnp.minimum(first, B * A - 1)]
+        if config.check_deadlock:
+            # TLC's default deadlock check: an expanded row with no enabled
+            # action (pre-constraint — CONSTRAINT gates exploration, not
+            # enabledness).  Flat priority b*A orders it before any
+            # successor of the same row, after earlier rows' successors.
+            dead = row_act & con_par & ~jnp.any(out["valid"], axis=1)
+            drow = jnp.min(jnp.where(dead, jnp.arange(B, dtype=I32), BIG))
+            dpos = jnp.where(drow < BIG // A, drow * A, BIG)
+            use_dead = dpos < first
+            first = jnp.minimum(first, dpos)
+            g_target = jnp.where(use_dead,
+                                 gstart + jnp.minimum(drow, B - 1), g_target)
+            bad_inv = jnp.where(use_dead, jnp.int32(n_inv), bad_inv)
+        has_viol = first < BIG
+        new_viol = has_viol & (viol_g < 0)
+        viol_g = jnp.where(new_viol, g_target, viol_g)
         viol_i = jnp.where(new_viol, bad_inv, viol_i)
         return Carry(store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
                      lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
@@ -589,7 +602,9 @@ class DeviceEngine:
                 st.unpack(rows[k], self.lay, np), self.bounds)
             label = self.table[int(lane[g])].label() if g > 0 else None
             chain.append((label, py))
-        inv_name = self.config.invariants[int(out["viol_i"])]
+        vi = int(out["viol_i"])
+        inv_name = DEADLOCK if vi == len(self.config.invariants) \
+            else self.config.invariants[vi]
         return Violation(invariant=inv_name, state=chain[-1][1], trace=chain)
 
 

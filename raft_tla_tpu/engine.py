@@ -51,9 +51,12 @@ from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym_mod
 
 
+from raft_tla_tpu.models.refbfs import DEADLOCK  # noqa: E402  (sentinel)
+
+
 @dataclasses.dataclass
 class Violation:
-    invariant: str
+    invariant: str          # registry name, or refbfs.DEADLOCK
     state: interp.PyState
     # Trace from Init: [(action_label | None, PyState)]; replayable by interp.
     trace: list
@@ -188,9 +191,22 @@ class Engine:
                         "violated (config.py capacity scheme)")
                 n_transitions += int(valid.sum())
 
+                # TLC's default deadlock check: an expanded state with no
+                # successor (stuttering excluded).  Successors of earlier
+                # rows in the chunk are recorded first — refbfs order.
+                dead_limit = None
+                if cfg.check_deadlock:
+                    dead = ~valid.any(axis=1)
+                    if dead.any():
+                        fb = int(np.argmax(dead))
+                        dead_limit = fb * A
+
                 # Dedup in discovery order: flat index = b * A + a.
                 flat_keys = keys.reshape(-1)
                 flat_valid = valid.reshape(-1)
+                if dead_limit is not None:
+                    flat_valid = flat_valid.copy()
+                    flat_valid[dead_limit:] = False
                 cand = np.nonzero(flat_valid)[0]
                 new_flat: list[int] = []
                 for fi in cand:
@@ -208,6 +224,10 @@ class Engine:
                         new_flat = new_flat[:t + 1]
                         break
                 if not new_flat:
+                    if dead_limit is not None:
+                        violation = self._make_violation(
+                            DEADLOCK, gidx[dead_limit // A], store, parents)
+                        break
                     continue
 
                 nf = np.asarray(new_flat, dtype=np.int64)
@@ -236,6 +256,9 @@ class Engine:
                         break
                     if c_ok:
                         next_frontier.append(g)
+                if violation is None and dead_limit is not None:
+                    violation = self._make_violation(
+                        DEADLOCK, gidx[dead_limit // A], store, parents)
                 if violation is not None:
                     break
             if violation is not None:

@@ -18,9 +18,12 @@ from raft_tla_tpu.config import CheckConfig
 from raft_tla_tpu.models import interp, invariants, spec as S
 
 
+DEADLOCK = "Deadlock"      # Violation.invariant sentinel (TLC -deadlock)
+
+
 @dataclasses.dataclass
 class Violation:
-    invariant: str
+    invariant: str          # registry name, or DEADLOCK
     state: interp.PyState
     # Trace from Init to the violating state: [(action_label | None, state)].
     trace: list
@@ -86,7 +89,9 @@ def check(config: CheckConfig, max_states: int | None = None,
         for s in frontier:
             if not interp.constraint_ok(s, bounds):
                 continue  # counted, invariant-checked, but not expanded
+            n_succ = 0
             for aidx, t in interp.successors(s, bounds, table):
+                n_succ += 1
                 n_transitions += 1
                 k = keyf(t)
                 if k in seen:
@@ -100,6 +105,11 @@ def check(config: CheckConfig, max_states: int | None = None,
                 if violation is not None:
                     break
                 nxt.append(t)
+            if violation is None and config.check_deadlock and n_succ == 0:
+                # TLC's default deadlock check: an expanded state with no
+                # successor at all (stuttering excluded).  CONSTRAINT gates
+                # exploration, not enabledness, so this is pre-constraint.
+                violation = make_violation(DEADLOCK, s)
             if violation is not None:
                 break
         if violation is not None:

@@ -42,7 +42,7 @@ from raft_tla_tpu.config import CheckConfig
 from raft_tla_tpu.device_engine import (
     _EMPTY, _dedup_insert, _progress_stats, BUCKET, Carry, FAIL_LEVEL,
     FAIL_PROBE, FAIL_RING, FAIL_WIDTH, decode_fail, _carry_done)
-from raft_tla_tpu.engine import EngineResult, Violation
+from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import bitpack
 from raft_tla_tpu.ops import fingerprint as fpr
@@ -131,13 +131,23 @@ def _build_segment(config: CheckConfig, caps: PagedCapacities, A: int,
             ~out["inv_ok"].reshape(B * A, n_inv), axis=-1) if n_inv \
             else jnp.zeros((B * A,), bool)
         first = jnp.min(jnp.where(inv_bad, jnp.arange(B * A, dtype=I32), BIG))
-        has_viol = first < BIG
-        new_viol = has_viol & (viol_g < 0)
-        viol_g = jnp.where(new_viol, pos[jnp.minimum(first, B * A - 1)],
-                           viol_g)
         bad_inv = jnp.argmax(
             ~out["inv_ok"].reshape(B * A, n_inv)
             [jnp.minimum(first, B * A - 1)]) if n_inv else jnp.int32(0)
+        g_target = pos[jnp.minimum(first, B * A - 1)]
+        if config.check_deadlock:
+            # TLC's default deadlock check (see device_engine.chunk_body).
+            dead = row_act & conflag[ridx] & ~jnp.any(out["valid"], axis=1)
+            drow = jnp.min(jnp.where(dead, jnp.arange(B, dtype=I32), BIG))
+            dpos = jnp.where(drow < BIG // A, drow * A, BIG)
+            use_dead = dpos < first
+            first = jnp.minimum(first, dpos)
+            g_target = jnp.where(use_dead,
+                                 start + jnp.minimum(drow, B - 1), g_target)
+            bad_inv = jnp.where(use_dead, jnp.int32(n_inv), bad_inv)
+        has_viol = first < BIG
+        new_viol = has_viol & (viol_g < 0)
+        viol_g = jnp.where(new_viol, g_target, viol_g)
         viol_i = jnp.where(new_viol, bad_inv, viol_i)
         return Carry(store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
                      lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
@@ -412,7 +422,9 @@ class PagedEngine:
                 label = self.table[int(lane_g[0])].label() if k > 0 else None
                 chain.append((label, py))
             violation = Violation(
-                invariant=self.config.invariants[int(viol_i)],
+                invariant=DEADLOCK
+                if int(viol_i) == len(self.config.invariants)
+                else self.config.invariants[int(viol_i)],
                 state=chain[-1][1], trace=chain)
         host.close()
 

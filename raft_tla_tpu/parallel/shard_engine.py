@@ -64,7 +64,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from raft_tla_tpu.config import CheckConfig
 from raft_tla_tpu.device_engine import _EMPTY, _dedup_insert, BUCKET
-from raft_tla_tpu.engine import EngineResult, Violation
+from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import fingerprint as fpr
 from raft_tla_tpu.ops import kernels
@@ -221,6 +221,18 @@ def _build_sharded_search(config: CheckConfig, caps: ShardCapacities,
         else:
             bad_inv = jnp.int32(0)
         viol_i = jnp.where(new_viol, bad_inv, viol_i)
+        if config.check_deadlock:
+            # TLC's default deadlock check, device-locally: an expanded row
+            # with no enabled action.  Which event is reported first when a
+            # deadlock and a violation coexist is interleaving-dependent
+            # here, like coverage attribution (module docstring) — either
+            # is a correct counterexample.
+            dead = row_act & con_par & ~jnp.any(out["valid"], axis=1)
+            drow = jnp.min(jnp.where(dead, jnp.arange(B, dtype=I32), BIG))
+            dl = (drow < BIG) & (viol_g < 0)
+            viol_g = jnp.where(
+                dl, dev * Ncap + gstart + jnp.minimum(drow, B - 1), viol_g)
+            viol_i = jnp.where(dl, jnp.int32(n_inv), viol_i)
 
         # replicated stop flag: any device saw a violation or failed
         stop = (jax.lax.psum((viol_g >= 0).astype(I32), _AXIS) > 0) | \
@@ -403,7 +415,8 @@ class ShardEngine:
                 st.unpack(rows[k], self.lay, np), self.bounds)
             label = self.table[int(lane[g])].label() if k > 0 else None
             chain.append((label, py))
-        inv_name = self.config.invariants[viol_i]
+        inv_name = DEADLOCK if viol_i == len(self.config.invariants) \
+            else self.config.invariants[viol_i]
         return Violation(invariant=inv_name, state=chain[-1][1], trace=chain)
 
 

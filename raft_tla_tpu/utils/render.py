@@ -109,8 +109,10 @@ def render_state(s, bounds: Bounds, indent: str = "    ") -> str:
 
 def render_trace(violation, bounds: Bounds) -> str:
     """TLC-style numbered counterexample trace."""
-    out = [f"Error: Invariant {violation.invariant} is violated.",
-           "Error: The behavior up to this point is:"]
+    from raft_tla_tpu.models.refbfs import DEADLOCK
+    head = "Error: Deadlock reached." if violation.invariant == DEADLOCK \
+        else f"Error: Invariant {violation.invariant} is violated."
+    out = [head, "Error: The behavior up to this point is:"]
     for k, (label, state) in enumerate(violation.trace, start=1):
         head = "<Initial predicate>" if label is None else f"<{label}>"
         out.append(f"State {k}: {head}")
