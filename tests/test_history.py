@@ -175,3 +175,20 @@ def test_leader_completeness_hist_seeded_violation():
                   for k, v in interp.to_struct(s_, BH).items()}
         assert bool(inv_mod.jnp_invariant(
             "LeaderCompletenessHist", BH)(struct)) is want
+
+
+def test_liveness_composes_with_faithful_mode():
+    """The liveness graph builds on interp.successors, so history state
+    flows through: EventuallyLeader holds under WF(Next) on the faithful
+    election universe and is stutter-refuted with no fairness, exactly as
+    in parity mode."""
+    from raft_tla_tpu.models import liveness
+    ch = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                   max_log=0, max_msgs=2, history=True,
+                                   max_elections=4),
+                     spec="election", invariants=())
+    g = liveness.explore_graph(ch)
+    assert liveness.check(ch, "EventuallyLeader", wf=("Next",),
+                          graph=g).holds
+    refuted = liveness.check(ch, "EventuallyLeader", wf=(), graph=g)
+    assert not refuted.holds and refuted.violation is not None
