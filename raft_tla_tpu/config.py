@@ -141,12 +141,6 @@ class CheckConfig:
     check_deadlock: bool = False           # TLC -deadlock analog (off: Restart is always enabled anyway)
 
     def __post_init__(self) -> None:
-        if self.symmetry and self.bounds.history:
-            # The orbit fingerprint would have to permute server ids inside
-            # election records, voterLog tables and mlog-carrying messages;
-            # not implemented — reject rather than silently mis-quotient.
-            raise ValueError(
-                "SYMMETRY is not supported in faithful (history) mode")
         if not self.bounds.history:
             from raft_tla_tpu.models.invariants import HISTORY_REGISTRY
             hist = [nm for nm in self.invariants if nm in HISTORY_REGISTRY]

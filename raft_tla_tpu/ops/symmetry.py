@@ -86,7 +86,7 @@ def permute_struct(struct: dict, p: tuple, bounds: Bounds, xp) -> dict:
         | (p_lut[(hi >> d_sh) & ((1 << d_w) - 1)] << d_sh)
     new_hi = xp.where(occupied, new_hi, hi)
 
-    return {
+    out = {
         "role": rows(struct["role"]),
         "term": rows(struct["term"]),
         "votedFor": vf_map[rows(struct["votedFor"])],
@@ -102,6 +102,26 @@ def permute_struct(struct: dict, p: tuple, bounds: Bounds, xp) -> dict:
         "msgLo": struct["msgLo"],
         "msgCount": struct["msgCount"],
     }
+    if "eTerm" in struct:
+        # Faithful-mode history (ops/state.py HISTORY_FIELDS).  Log ranks
+        # contain no server ids, so allLogs/eLog/mlog are fixed points;
+        # voterLog permutes both axes like nextIndex, election records
+        # remap eleader/evotes/evoterLog (slot re-sort happens in the
+        # caller's canonicalize, like the message bag).
+        eocc = struct["eTerm"] > 0
+        lead_lut = xp.asarray(p)
+        out.update({
+            "allLogs": struct["allLogs"],
+            "vLog": struct["vLog"][inv_idx, :][:, inv_idx],
+            "eTerm": struct["eTerm"],
+            "eLeader": xp.where(eocc, lead_lut[struct["eLeader"]],
+                                struct["eLeader"]),
+            "eLog": struct["eLog"],
+            "eVotes": xp.where(eocc, bitperm(struct["eVotes"]),
+                               struct["eVotes"]),
+            "eVLog": struct["eVLog"][:, inv_idx],
+        })
+    return out
 
 
 def orbit_fingerprint(struct: dict, bounds: Bounds, consts, xp):

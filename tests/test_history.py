@@ -64,8 +64,6 @@ def test_layout_and_struct_roundtrip():
 
 
 def test_config_gates():
-    with pytest.raises(ValueError, match="SYMMETRY"):
-        CheckConfig(bounds=BH, symmetry=("Server",))
     with pytest.raises(ValueError, match="faithful"):
         CheckConfig(invariants=("ElectionSafetyHist",))
     with pytest.raises(ValueError, match="universe"):
@@ -192,3 +190,32 @@ def test_liveness_composes_with_faithful_mode():
                           graph=g).holds
     refuted = liveness.check(ch, "EventuallyLeader", wf=(), graph=g)
     assert not refuted.holds and refuted.violation is not None
+
+
+def test_symmetry_composes_with_faithful_mode():
+    """History is Server-equivariant (log ranks carry no server ids;
+    voterLog/eLeader/eVotes/eVLog permute), so SYMMETRY quotients faithful
+    spaces too.  On the election universe faithful equals parity state for
+    state, so the orbit count must be the known parity figure."""
+    from raft_tla_tpu import engine
+    bh = Bounds(n_servers=2, n_values=1, max_term=2, max_log=0, max_msgs=2,
+                history=True, max_elections=4)
+    cc = CheckConfig(bounds=bh, spec="election",
+                     invariants=("NoTwoLeaders",), symmetry=("Server",),
+                     chunk=256)
+    r = refbfs.check(cc)
+    assert (r.n_states, r.diameter) == (1514, 17)     # 3014 states / 2 = ...
+    e = engine.check(cc)
+    assert (e.n_states, e.diameter) == (1514, 17)
+    assert e.coverage == r.coverage
+
+    bf = Bounds(n_servers=2, n_values=1, max_term=2, max_log=1, max_msgs=2,
+                history=True, max_elections=4)
+    cf = CheckConfig(bounds=bf, spec="full",
+                     invariants=("NoTwoLeaders", "ElectionSafetyHist"),
+                     symmetry=("Server",), chunk=512)
+    rf = refbfs.check(cf)
+    assert (rf.n_states, rf.diameter) == (26723, 32)  # orbits of the 53398
+    assert rf.violation is None
+    ef = engine.check(cf)
+    assert (ef.n_states, ef.diameter) == (26723, 32)
