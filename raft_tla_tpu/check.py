@@ -246,7 +246,9 @@ def _run(args, config):
         eng = ShardEngine(config, make_mesh(args.devices),
                           ShardCapacities(n_states=args.cap,
                                           levels=args.levels))
-        return eng.check()
+        return eng.check(checkpoint=args.checkpoint,
+                         checkpoint_every_s=args.checkpoint_every,
+                         resume=args.resume, on_progress=_stats_cb(args))
     from raft_tla_tpu.device_engine import Capacities, DeviceEngine
     eng = DeviceEngine(config, Capacities(n_states=args.cap,
                                           levels=args.levels))
@@ -258,13 +260,13 @@ def _run(args, config):
 def main(argv=None) -> int:
     p = build_argparser()
     args = p.parse_args(argv)
-    if (args.checkpoint or args.resume) and args.engine not in ("device",
-                                                                 "paged"):
-        p.error(f"--checkpoint/--resume require --engine device or paged "
-                f"(got {args.engine}); other engines would silently "
+    if (args.checkpoint or args.resume) and args.engine not in (
+            "device", "paged", "shard"):
+        p.error(f"--checkpoint/--resume require --engine device, paged or "
+                f"shard (got {args.engine}); other engines would silently "
                 "ignore them")
-    if args.stats and args.engine not in ("device", "paged"):
-        p.error(f"--stats requires --engine device or paged "
+    if args.stats and args.engine not in ("device", "paged", "shard"):
+        p.error(f"--stats requires --engine device, paged or shard "
                 f"(got {args.engine})")
     try:
         config, props = _resolve_config(args)

@@ -3,8 +3,13 @@
 The reference is single-process (TLC's distributed mode is unused —
 SURVEY §2.9); this module is the scale-out design the task demands, built the
 TPU way: ``jax.sharding.Mesh`` + ``shard_map`` + XLA collectives, not
-NCCL/MPI.  The whole multi-device search is still **one jitted computation**
-(the device_engine.py architecture), with three collectives in the hot loop:
+NCCL/MPI.  The multi-device search runs as **watchdog-safe segments** (the
+device_engine.py architecture): one jitted program advances the whole mesh by
+up to ``budget`` chunk expansions and returns the carry with its buffers
+donated back into the next dispatch — so a search of any length survives the
+deployment tunnel's ~60 s program watchdog, the host can snapshot the carry
+for checkpoint/resume (TLC ``-recover``), and per-segment stats stream out.
+Three collectives run in the hot loop:
 
 - **all_to_all** — fingerprint-prefix dedup exchange (SURVEY §2.9 row SP):
   every chip owns the slice of fingerprint space ``fp_hi % n_dev == d``.
@@ -33,16 +38,16 @@ This is the checker's DP axis; the per-state action fan-out is its TP axis
 
 Determinism: within a device, candidate order is (sender device, send slot) —
 fixed — so parent links and local discovery order are reproducible run to
-run.  Global discovery order differs from the single-chip engines (states
-interleave across chips), so total counts, per-level counts, transition
-counts, verdicts and diameter all match refbfs/DeviceEngine exactly, while
-(a) a violation trace may be a *different valid counterexample* than the
-single-chip one (still replayable — tested), and (b) per-action coverage
-*attribution* can differ when the same new state is producible by several
-actions within one level — the first discoverer gets credit, and "first"
-depends on interleaving.  Coverage *totals* still equal n_states - 1
-(every non-initial state credited exactly once); TLC's own multi-worker
-mode has the same attribution nondeterminism.
+run, and a checkpoint resume replays the identical search.  Global discovery
+order differs from the single-chip engines (states interleave across chips),
+so total counts, per-level counts, transition counts, verdicts and diameter
+all match refbfs/DeviceEngine exactly, while (a) a violation trace may be a
+*different valid counterexample* than the single-chip one (still replayable —
+tested), and (b) per-action coverage *attribution* can differ when the same
+new state is producible by several actions within one level — the first
+discoverer gets credit, and "first" depends on interleaving.  Coverage
+*totals* still equal n_states - 1 (every non-initial state credited exactly
+once); TLC's own multi-worker mode has the same attribution nondeterminism.
 
 Differences vs TLC's distributed mode (Java sockets, central fingerprint
 server): here dedup is sharded, not centralized, and the exchange is a
@@ -55,25 +60,30 @@ import dataclasses
 import functools
 import time
 from collections import Counter
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_tla_tpu.config import CheckConfig
-from raft_tla_tpu.device_engine import _EMPTY, _dedup_insert, BUCKET
+from raft_tla_tpu.device_engine import (
+    _EMPTY, _dedup_insert, BUCKET, FAIL_LEVEL, FAIL_PROBE, FAIL_STORE,
+    FAIL_WIDTH, decode_fail)
 from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import fingerprint as fpr
 from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym_mod
+from raft_tla_tpu.utils import ckpt
 
 I32 = jnp.int32
 U32 = jnp.uint32
 _AXIS = "d"     # the frontier/fingerprint mesh axis (DP, SURVEY §2.9)
+# routing-buffer overflow (shard engine only; continues the FAIL_* bitmask)
+FAIL_ROUTE = 32
 
 
 @dataclasses.dataclass(frozen=True)
@@ -107,16 +117,56 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.asarray(devs), (_AXIS,))
 
 
-def _build_sharded_search(config: CheckConfig, caps: ShardCapacities,
-                          A: int, W: int, ndev: int):
-    """The per-device program; run under shard_map over the ``d`` axis."""
+class SCarry(NamedTuple):
+    """The segment carry — the entire mesh-wide search state.
+
+    Leaves marked [dev] are sharded over the mesh axis (global leading dim
+    ``ndev * per-device``; scalars are shape-[1] per device, [ndev] global);
+    the rest are replicated lockstep values, identical on every device by
+    construction (they only change through psum/pmax results).
+    """
+
+    store: jax.Array      # [dev] [Ncap, W] states this device owns
+    parent: jax.Array     # [dev] [Ncap] parent GLOBAL id (dev*Ncap + row)
+    lane: jax.Array       # [dev] [Ncap]
+    conflag: jax.Array    # [dev] [Ncap]
+    tbl_hi: jax.Array     # [dev] [TBd, BUCKET]
+    tbl_lo: jax.Array     # [dev] [TBd, BUCKET]
+    n_states: jax.Array   # [dev] [1]
+    lvl_start: jax.Array  # [dev] [1] local level window
+    lvl_end: jax.Array    # [dev] [1]
+    viol_g: jax.Array     # [dev] [1] first violating GLOBAL id, -1 if none
+    viol_i: jax.Array     # [dev] [1] invariant index (n_inv = deadlock)
+    n_trans: jax.Array    # [dev] [1]
+    cov: jax.Array        # [dev] [A]
+    fail: jax.Array       # [dev] [1] FAIL_* bitmask
+    levels: jax.Array     # replicated [Lcap] global per-level new states
+    lvl: jax.Array        # replicated scalar
+    c: jax.Array          # replicated scalar: chunk cursor within level
+    n_chunks: jax.Array   # replicated scalar: lockstep chunks this level
+    stop: jax.Array       # replicated scalar bool
+
+
+_SHARDED = ("store", "parent", "lane", "conflag", "tbl_hi", "tbl_lo",
+            "n_states", "lvl_start", "lvl_end", "viol_g", "viol_i",
+            "n_trans", "cov", "fail")
+
+
+def _carry_specs():
+    return SCarry(**{f: P(_AXIS) if f in _SHARDED else P()
+                     for f in SCarry._fields})
+
+
+def _build_segment(config: CheckConfig, caps: ShardCapacities,
+                   A: int, W: int, ndev: int):
+    """One watchdog-safe slice of the mesh-wide search (<= budget chunks)."""
     B = config.chunk
     n_inv = len(config.invariants)
     if n_inv > 29:
         raise ValueError("at most 29 invariants (bit-packed into int32 flags)")
     step = kernels.build_step(config.bounds, config.spec,
                               tuple(config.invariants), config.symmetry)
-    Ncap, Lcap, Tcap = caps.n_states, caps.levels, caps.table
+    Ncap, Lcap = caps.n_states, caps.levels
     Csend = caps.send if caps.send is not None else B * A
     BIG = jnp.int32(np.iinfo(np.int32).max)
 
@@ -124,13 +174,17 @@ def _build_sharded_search(config: CheckConfig, caps: ShardCapacities,
         """FP-prefix shard map: which device dedups/stores this state."""
         return (key_hi % jnp.uint32(ndev)).astype(I32)
 
-    def chunk_body(carry, c):
-        (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
-         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail, stop) = carry
+    def chunk_body(carry: SCarry) -> SCarry:
         dev = jax.lax.axis_index(_AXIS).astype(I32)
+        lvl_start, lvl_end = carry.lvl_start[0], carry.lvl_end[0]
+        n_states, fail = carry.n_states[0], carry.fail[0]
+        viol_g, viol_i = carry.viol_g[0], carry.viol_i[0]
+        store, parent, lane = carry.store, carry.parent, carry.lane
+        conflag, tbl_hi, tbl_lo = carry.conflag, carry.tbl_hi, carry.tbl_lo
+        n_trans, cov = carry.n_trans[0], carry.cov
 
         # ---- expand my chunk (rows may be inactive on ragged levels) ----
-        start = lvl_start + c * B
+        start = lvl_start + carry.c * B
         gstart = jnp.clip(start, 0, Ncap - B)
         rows_l = gstart + jnp.arange(B, dtype=I32)
         row_act = (rows_l >= start) & (rows_l < lvl_end)
@@ -139,7 +193,7 @@ def _build_sharded_search(config: CheckConfig, caps: ShardCapacities,
         con_par = jax.lax.dynamic_slice(conflag, (gstart,), (B,))
         valid = out["valid"] & row_act[:, None] & con_par[:, None]
         n_trans = n_trans + jnp.sum(valid.astype(I32))
-        fail = fail | jnp.any(valid & out["overflow"])
+        fail = fail | jnp.any(valid & out["overflow"]) * FAIL_WIDTH
 
         # ---- route candidates to their fingerprint owners ----
         BA = B * A
@@ -151,7 +205,7 @@ def _build_sharded_search(config: CheckConfig, caps: ShardCapacities,
         cum = jnp.cumsum(oh.astype(I32), axis=0)
         pos = jnp.take_along_axis(
             cum, jnp.clip(dest, 0, ndev - 1)[:, None], axis=1)[:, 0] - 1
-        fail = fail | jnp.any(fvalid & (pos >= Csend))   # routing overflow
+        fail = fail | jnp.any(fvalid & (pos >= Csend)) * FAIL_ROUTE
         slot = jnp.where(fvalid & (pos < Csend), dest * Csend + pos,
                          ndev * Csend)
 
@@ -191,7 +245,7 @@ def _build_sharded_search(config: CheckConfig, caps: ShardCapacities,
         # ---- owner-side dedup + append (same protocol as device_engine) ----
         tbl_hi, tbl_lo, is_new, pfail = _dedup_insert(
             tbl_hi, tbl_lo, r_hi, r_lo, active)
-        fail = fail | pfail
+        fail = fail | pfail * FAIL_PROBE
         pos_st = n_states + jnp.cumsum(is_new.astype(I32)) - 1
         sl = jnp.where(is_new & (pos_st < Ncap), pos_st, Ncap)
         store = store.at[sl].set(r_vec, mode="drop")
@@ -200,7 +254,7 @@ def _build_sharded_search(config: CheckConfig, caps: ShardCapacities,
         conflag = conflag.at[sl].set(((r_flags >> 1) & 1) == 1, mode="drop")
         cov = cov.at[jnp.where(is_new, r_lane, A)].add(1, mode="drop")
         n_new = jnp.sum(is_new.astype(I32))
-        fail = fail | (n_states + n_new > Ncap)
+        fail = fail | (n_states + n_new > Ncap) * FAIL_STORE
         n_states = jnp.minimum(n_states + n_new, Ncap)
 
         # ---- first invariant violation among my new states ----
@@ -236,90 +290,78 @@ def _build_sharded_search(config: CheckConfig, caps: ShardCapacities,
 
         # replicated stop flag: any device saw a violation or failed
         stop = (jax.lax.psum((viol_g >= 0).astype(I32), _AXIS) > 0) | \
-            (jax.lax.pmax(fail.astype(I32), _AXIS) > 0)
-        return (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
-                lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail, stop)
+            (jax.lax.pmax(fail, _AXIS) != 0)
+        return carry._replace(
+            store=store, parent=parent, lane=lane, conflag=conflag,
+            tbl_hi=tbl_hi, tbl_lo=tbl_lo,
+            n_states=n_states[None], n_trans=n_trans[None], cov=cov,
+            viol_g=viol_g[None], viol_i=viol_i[None], fail=fail[None],
+            stop=stop, c=carry.c + 1)
 
-    def level_body(carry):
-        (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
-         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail, stop,
-         levels, lvl) = carry
-        # lockstep chunk count across devices (all_to_all needs everyone)
+    def outer_body(sc):
+        """Run chunks until the level is exhausted, the budget runs out, or
+        a stop event lands; then (maybe) advance the level window."""
+        steps, carry = sc
+
+        def ccond(cc):
+            s, inner = cc
+            return (inner.c < inner.n_chunks) & ~inner.stop & (s < budget)
+
+        def cbody(cc):
+            s, inner = cc
+            return s + 1, chunk_body(inner)
+
+        steps, carry = jax.lax.while_loop(ccond, cbody, (steps, carry))
+        # Level advance (lockstep: c/n_chunks/stop are replicated).
+        adv = (carry.c >= carry.n_chunks) & ~carry.stop
+        n_new = carry.n_states[0] - carry.lvl_end[0]
+        n_new_tot = jax.lax.psum(n_new, _AXIS)
+        levels = jnp.where(
+            adv,
+            carry.levels.at[jnp.minimum(carry.lvl, Lcap - 1)].set(n_new_tot),
+            carry.levels)
+        fail = carry.fail[0] | (
+            adv & (carry.lvl >= Lcap - 1) & (n_new_tot > 0)) * FAIL_LEVEL
+        lvl_start = jnp.where(adv, carry.lvl_end[0], carry.lvl_start[0])
+        lvl_end = jnp.where(adv, carry.n_states[0], carry.lvl_end[0])
         n_act = lvl_end - lvl_start
-        n_chunks = jax.lax.pmax((n_act + B - 1) // B, _AXIS)
+        n_chunks = jnp.where(
+            adv, jax.lax.pmax((n_act + B - 1) // B, _AXIS), carry.n_chunks)
+        stop = carry.stop | (adv & (n_new_tot == 0)) | \
+            (jax.lax.pmax(fail, _AXIS) != 0)
+        return steps, carry._replace(
+            levels=levels, fail=fail[None],
+            lvl_start=lvl_start[None], lvl_end=lvl_end[None],
+            lvl=jnp.where(adv, carry.lvl + 1, carry.lvl),
+            c=jnp.where(adv, 0, carry.c), n_chunks=n_chunks, stop=stop)
 
-        def ccond(c_carry):
-            c, inner = c_carry
-            return (c < n_chunks) & ~inner[14]
+    def outer_cond(sc):
+        steps, carry = sc
+        return (steps < budget) & ~carry.stop
 
-        def cbody(c_carry):
-            c, inner = c_carry
-            return c + 1, chunk_body(inner, c)
+    def segment(carry: SCarry, budget_):
+        nonlocal budget
+        budget = budget_
+        _, carry = jax.lax.while_loop(outer_cond, outer_body,
+                                      (jnp.int32(0), carry))
+        return carry
 
-        inner = (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
-                 lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
-                 jnp.bool_(False))
-        _, inner = jax.lax.while_loop(ccond, cbody, (jnp.int32(0), inner))
-        (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
-         lvl_start, lvl_end, viol_g, viol_i, n_trans, cov, fail,
-         stop) = inner
-        n_new_tot = jax.lax.psum(n_states - lvl_end, _AXIS)  # replicated
-        levels = levels.at[jnp.minimum(lvl, Lcap - 1)].set(n_new_tot)
-        fail = fail | ((lvl >= Lcap - 1) & (n_new_tot > 0))
-        stop = stop | (jax.lax.pmax(fail.astype(I32), _AXIS) > 0) | \
-            (n_new_tot == 0)
-        return (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
-                lvl_end, n_states, viol_g, viol_i, n_trans, cov, fail,
-                stop, levels, lvl + 1)
-
-    def level_cond(carry):
-        stop = carry[14]
-        return ~stop
-
-    def search(init_vec, init_hi, init_lo, init_con):
-        """Per-device program.  Scalar inputs are replicated."""
-        dev = jax.lax.axis_index(_AXIS).astype(I32)
-        mine = owner(init_hi) == dev
-        store = jnp.zeros((Ncap, W), I32).at[0].set(
-            jnp.where(mine, init_vec, 0))
-        parent = jnp.full((Ncap,), -1, I32)
-        lane = jnp.full((Ncap,), -1, I32)
-        conflag = jnp.zeros((Ncap,), bool).at[0].set(mine & init_con)
-        TBd = Tcap // BUCKET
-        ib = (init_lo & jnp.uint32(TBd - 1)).astype(I32)
-        tbl_hi = jnp.full((TBd, BUCKET), _EMPTY, U32).at[ib, 0].set(
-            jnp.where(mine, init_hi, _EMPTY))
-        tbl_lo = jnp.full((TBd, BUCKET), _EMPTY, U32).at[ib, 0].set(
-            jnp.where(mine, init_lo, _EMPTY))
-        levels = jnp.zeros((Lcap,), I32)
-        n0 = jnp.where(mine, 1, 0).astype(I32)
-        carry = (store, parent, lane, conflag, tbl_hi, tbl_lo,
-                 n0, jnp.int32(0), n0,
-                 jnp.int32(-1), jnp.int32(0), jnp.int32(0),
-                 jnp.zeros((A,), I32), jnp.bool_(False), jnp.bool_(False),
-                 levels, jnp.int32(1))
-        carry = jax.lax.while_loop(level_cond, level_body, carry)
-        (store, parent, lane, conflag, _th, _tl, n_states, _ls, _le,
-         viol_g, viol_i, n_trans, cov, fail, _stop, levels, lvl) = carry
-        return {
-            # sharded outputs (global view is the concatenation over devices)
-            "store": store, "parent": parent, "lane": lane,
-            "n_states": n_states[None], "viol_g": viol_g[None],
-            "viol_i": viol_i[None], "fail": fail[None],
-            # replicated outputs
-            "n_transitions": jax.lax.psum(n_trans, _AXIS),
-            "coverage": jax.lax.psum(cov, _AXIS),
-            "levels": levels, "n_levels": lvl,
-        }
-
-    return search
+    budget = None
+    return segment
 
 
 class ShardEngine:
-    """One compiled multi-device exhaustive checker; reusable across runs."""
+    """Segmented multi-device exhaustive checker; reusable across runs.
+
+    Same watchdog/checkpoint architecture as DeviceEngine: donated carries,
+    adaptive segment budgets, atomic digest-guarded snapshots."""
+
+    SEG_TARGET_S = 8.0
+    SEG_CLAMP_S = 25.0
+    SEG_MIN, SEG_MAX = 16, 1 << 16
 
     def __init__(self, config: CheckConfig, mesh: Mesh | None = None,
-                 caps: ShardCapacities | None = None):
+                 caps: ShardCapacities | None = None, seg_chunks: int = 256):
         self.config = config
         self.bounds = config.bounds
         self.lay = st.Layout.of(self.bounds)
@@ -330,21 +372,83 @@ class ShardEngine:
         self.caps = caps or ShardCapacities()
         if self.caps.n_states < config.chunk:
             raise ValueError("ShardCapacities.n_states must be >= chunk")
-        fn = _build_sharded_search(config, self.caps, self.A,
-                                   self.lay.width, self.ndev)
-        sharded = {"store": P(_AXIS), "parent": P(_AXIS), "lane": P(_AXIS),
-                   "n_states": P(_AXIS), "viol_g": P(_AXIS),
-                   "viol_i": P(_AXIS), "fail": P(_AXIS)}
-        out_specs = {k: sharded.get(k, P()) for k in (
-            "store", "parent", "lane", "n_states", "viol_g", "viol_i",
-            "fail", "n_transitions", "coverage", "levels", "n_levels")}
-        self._search = jax.jit(jax.shard_map(
-            fn, mesh=self.mesh,
-            in_specs=(P(), P(), P(), P()),   # replicated init
-            out_specs=out_specs, check_vma=False))
+        self.seg_chunks = seg_chunks
+        specs = _carry_specs()
+        fn = _build_segment(config, self.caps, self.A, self.lay.width,
+                            self.ndev)
+        self._segment = jax.jit(jax.shard_map(
+            fn, mesh=self.mesh, in_specs=(specs, P()), out_specs=specs,
+            check_vma=False), donate_argnums=(0,))
+        self._shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs)
 
-    def check(self, init_override: interp.PyState | None = None
-              ) -> EngineResult:
+    # -- carry construction / checkpointing ---------------------------------
+
+    def _init_carry(self, init_vec, hi0, lo0, con0) -> SCarry:
+        """Host-built initial carry: Init lives on its fingerprint owner."""
+        nd, Ncap, A = self.ndev, self.caps.n_states, self.A
+        W, Lcap = self.lay.width, self.caps.levels
+        TBd = self.caps.table // BUCKET
+        own = int(np.uint32(hi0) % np.uint32(nd))
+        store = np.zeros((nd * Ncap, W), np.int32)
+        store[own * Ncap] = init_vec
+        parent = np.full((nd * Ncap,), -1, np.int32)
+        lane = np.full((nd * Ncap,), -1, np.int32)
+        conflag = np.zeros((nd * Ncap,), bool)
+        conflag[own * Ncap] = con0
+        tbl_hi = np.full((nd * TBd, BUCKET), _EMPTY, np.uint32)
+        tbl_lo = np.full((nd * TBd, BUCKET), _EMPTY, np.uint32)
+        b0 = int(np.uint32(lo0) & np.uint32(TBd - 1))
+        tbl_hi[own * TBd + b0, 0] = hi0
+        tbl_lo[own * TBd + b0, 0] = lo0
+        n0 = np.zeros((nd,), np.int32)
+        n0[own] = 1
+        carry = SCarry(
+            store=store, parent=parent, lane=lane, conflag=conflag,
+            tbl_hi=tbl_hi, tbl_lo=tbl_lo,
+            n_states=n0, lvl_start=np.zeros((nd,), np.int32),
+            lvl_end=n0.copy(),
+            viol_g=np.full((nd,), -1, np.int32),
+            viol_i=np.zeros((nd,), np.int32),
+            n_trans=np.zeros((nd,), np.int32),
+            cov=np.zeros((nd * A,), np.int32),
+            fail=np.zeros((nd,), np.int32),
+            levels=np.zeros((Lcap,), np.int32),
+            lvl=np.int32(1), c=np.int32(0), n_chunks=np.int32(1),
+            stop=np.bool_(False))
+        return self._put(carry)
+
+    def _put(self, carry: SCarry) -> SCarry:
+        return SCarry(*(jax.device_put(x, s)
+                        for x, s in zip(carry, self._shardings)))
+
+    def save_checkpoint(self, path: str, carry: SCarry,
+                        init_key: tuple) -> None:
+        """Atomic digest-guarded snapshot of the mesh-wide carry (the mesh
+        size joins the digest key — a checkpoint is only resumable on an
+        equal-size mesh, since the FP-ownership map depends on it)."""
+        host = jax.device_get(carry)
+        ckpt.atomic_savez(
+            path,
+            **{f"c{i}": np.asarray(x) for i, x in enumerate(host)},
+            config_digest=np.uint64(ckpt.config_digest(
+                self.config, self.caps, init_key + (self.ndev,))))
+
+    def load_checkpoint(self, path: str, init_key: tuple) -> SCarry:
+        with ckpt.load_npz_checked(
+                path, ckpt.config_digest(
+                    self.config, self.caps,
+                    init_key + (self.ndev,))) as z:
+            arrs = [z[f"c{i}"] for i in range(len(SCarry._fields))]
+        return self._put(SCarry(*arrs))
+
+    # -- public API ----------------------------------------------------------
+
+    def check(self, init_override: interp.PyState | None = None,
+              checkpoint: str | None = None,
+              checkpoint_every_s: float = 600.0,
+              resume: str | None = None,
+              on_progress=None) -> EngineResult:
         t0 = time.monotonic()
         bounds = self.bounds
         init_py = init_override if init_override is not None \
@@ -361,54 +465,111 @@ class ShardEngine:
                     violation=Violation(nm, init_py, [(None, init_py)]),
                     levels=[1], wall_s=time.monotonic() - t0)
 
-        out = self._search(jnp.asarray(init_vec, I32), jnp.uint32(hi0),
-                           jnp.uint32(lo0),
-                           jnp.bool_(interp.constraint_ok(init_py, bounds)))
-        n_states = int(np.asarray(out["n_states"]).sum())
-        if bool(np.asarray(out["fail"]).any()):
+        carry = self.load_checkpoint(resume, (hi0, lo0)) if resume \
+            else self._init_carry(
+                np.asarray(init_vec, np.int32), np.uint32(hi0),
+                np.uint32(lo0), bool(interp.constraint_ok(init_py, bounds)))
+
+        budget = max(1, self.seg_chunks)
+        first = True
+        worst_s_per_chunk = 0.0
+        last_ckpt = time.monotonic()
+        while True:
+            t_seg = time.monotonic()
+            carry = self._segment(carry, jnp.int32(budget))
+            if on_progress is not None:
+                on_progress(self._progress_stats(carry, t0))
+            if bool(np.asarray(carry.stop)):
+                break
+            if checkpoint and (time.monotonic() - last_ckpt
+                               >= checkpoint_every_s):
+                self.save_checkpoint(checkpoint, carry, (hi0, lo0))
+                last_ckpt = time.monotonic()
+            dt = time.monotonic() - t_seg
+            if not first and dt > 0.05:
+                # Same watchdog clamp as DeviceEngine.check: never project a
+                # segment past SEG_CLAMP_S at the worst chunk cost seen.
+                worst_s_per_chunk = max(worst_s_per_chunk, dt / budget)
+                scale = min(2.0, max(0.25, self.SEG_TARGET_S / dt))
+                budget = int(min(self.SEG_MAX,
+                                 max(self.SEG_MIN, budget * scale)))
+                budget = max(self.SEG_MIN, min(
+                    budget, int(self.SEG_CLAMP_S / worst_s_per_chunk)))
+                self.seg_chunks = budget
+            first = False
+
+        (n_states_d, viol_gs, viol_is, n_trans_d, fail_d, n_levels,
+         levels_dev, cov_arr) = jax.device_get(
+             (carry.n_states, carry.viol_g, carry.viol_i, carry.n_trans,
+              carry.fail, carry.lvl, carry.levels, carry.cov))
+        fail = int(np.bitwise_or.reduce(np.asarray(fail_d)))
+        if fail:
+            parts = [decode_fail(fail & ~FAIL_ROUTE)] \
+                if fail & ~FAIL_ROUTE else []
+            if fail & FAIL_ROUTE:
+                parts.append("routing-buffer capacity exceeded")
             raise RuntimeError(
-                "sharded search aborted: store/level/probe/routing capacity "
-                f"exceeded (caps={self.caps}, ndev={self.ndev}) — grow "
+                f"sharded search aborted: {'; '.join(parts)} "
+                f"(caps={self.caps}, ndev={self.ndev}) — grow "
                 "ShardCapacities and rerun")
-        viol_gs = np.asarray(out["viol_g"])
+        n_states = int(np.asarray(n_states_d).sum())
+        viol_gs = np.asarray(viol_gs)
         viol_devs = np.nonzero(viol_gs >= 0)[0]
-        n_levels = int(out["n_levels"])
+        # The partially-explored violating level is never recorded (the
+        # level window only advances on completed levels), matching refbfs.
         levels_arr = [1] + [int(x) for x in
-                            np.asarray(out["levels"][:n_levels]) if int(x) > 0]
-        if viol_devs.size and len(levels_arr) > 1:
-            levels_arr = levels_arr[:-1]    # violating level is partial
-        cov_arr = np.asarray(out["coverage"])
+                            np.asarray(levels_dev)[:int(n_levels)]
+                            if int(x) > 0]
+        cov_tot = np.asarray(cov_arr).reshape(self.ndev, self.A).sum(axis=0)
         coverage: Counter = Counter()
         for a, inst in enumerate(self.table):
-            if cov_arr[a]:
-                coverage[inst.family] += int(cov_arr[a])
+            if cov_tot[a]:
+                coverage[inst.family] += int(cov_tot[a])
 
         violation = None
         if viol_devs.size:
             d = int(viol_devs[0])
             violation = self._extract_trace(
-                out, int(viol_gs[d]), int(np.asarray(out["viol_i"])[d]))
+                carry, int(viol_gs[d]), int(np.asarray(viol_is)[d]))
 
         return EngineResult(
             n_states=n_states,
             diameter=len(levels_arr) - 1,
-            n_transitions=int(out["n_transitions"]),
+            n_transitions=int(np.asarray(n_trans_d).sum()),
             coverage=coverage,
             violation=violation,
             levels=levels_arr,
             wall_s=time.monotonic() - t0)
 
-    def _extract_trace(self, out, viol_g: int, viol_i: int) -> Violation:
+    def _progress_stats(self, carry: SCarry, t0: float) -> dict:
+        n_states_d, lvl, n_trans_d = jax.device_get(
+            (carry.n_states, carry.lvl, carry.n_trans))
+        n_states = int(np.asarray(n_states_d).sum())
+        n_trans = int(np.asarray(n_trans_d).sum())
+        wall = time.monotonic() - t0
+        return {
+            "wall_s": round(wall, 3),
+            "n_states": n_states,
+            "level": int(lvl),
+            "n_transitions": n_trans,
+            "n_devices": self.ndev,
+            "dedup_hit_rate": round(
+                max(0.0, 1.0 - n_states / max(n_trans, 1)), 4),
+            "states_per_sec": round(n_states / max(wall, 1e-9), 1),
+        }
+
+    def _extract_trace(self, carry: SCarry, viol_g: int,
+                       viol_i: int) -> Violation:
         """Walk the cross-device parent chain through the global arrays."""
-        parent = np.asarray(out["parent"])   # [ndev * Ncap]
-        lane = np.asarray(out["lane"])
+        parent = np.asarray(carry.parent)   # [ndev * Ncap]
+        lane = np.asarray(carry.lane)
         chain_idx = []
         cur = viol_g
         while cur >= 0:
             chain_idx.append(cur)
             cur = int(parent[cur])
         chain_idx.reverse()
-        rows = np.asarray(out["store"][jnp.asarray(chain_idx)])
+        rows = np.asarray(carry.store[jnp.asarray(chain_idx)])
         chain = []
         for k, g in enumerate(chain_idx):
             py = interp.from_struct(

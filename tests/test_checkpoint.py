@@ -98,3 +98,32 @@ def test_stream_rows_width_mismatch_rejected(tmp_path):
     assert sum(b.shape[0] for b in got) == 5
     with pytest.raises(ValueError, match="row width"):
         ckpt.stream_rows_in(p, got.append, 5, expect_width=4)
+
+
+def test_shard_checkpoint_resume_bit_exact(tmp_path):
+    """Same carry-purity argument on the 8-device mesh: a snapshot taken
+    mid-search resumes to the identical result (and a different mesh size
+    is rejected — the FP-ownership map depends on it)."""
+    from raft_tla_tpu.parallel.shard_engine import (ShardCapacities,
+                                                    ShardEngine, make_mesh)
+    ck = str(tmp_path / "shard.ckpt")
+    caps = ShardCapacities(n_states=1 << 12, levels=64)
+
+    def eng(n=8):
+        e = ShardEngine(CFG, make_mesh(n), caps, seg_chunks=8)
+        e.SEG_MAX = 8
+        return e
+
+    straight = eng().check()
+    res = eng().check(checkpoint=ck, checkpoint_every_s=0.0)
+    assert res.n_states == straight.n_states
+    resumed = eng().check(resume=ck)
+    assert resumed.n_states == straight.n_states
+    assert resumed.diameter == straight.diameter
+    assert resumed.levels == straight.levels
+    assert resumed.n_transitions == straight.n_transitions
+    assert resumed.coverage == straight.coverage
+    assert resumed.violation is None
+
+    with pytest.raises(ValueError, match="checkpoint"):
+        eng(4).check(resume=ck)
