@@ -145,13 +145,14 @@ def _resolve_config(args):
             f"unknown PROPERTY {bad_props}; registry: "
             f"{sorted(live_mod.PROPERTIES)}")
     sym_names = set(cfg.symmetry) | ({"Server"} if args.symmetry else set())
-    bad_sym = sym_names - {"Server", "SymServer"}
+    bad_sym = sym_names - {"Server", "SymServer", "Value", "SymValue"}
     if bad_sym:
         raise ValueError(
-            f"SYMMETRY {sorted(bad_sym)} not supported: only Server "
-            "permutation symmetry is implemented (name it Server or "
-            "SymServer)")
-    symmetry = ("Server",) if sym_names else ()
+            f"SYMMETRY {sorted(bad_sym)} not supported: Server and/or "
+            "Value permutation symmetry (name them Server/SymServer, "
+            "Value/SymValue)")
+    symmetry = tuple(ax for ax in ("Server", "Value")
+                     if {ax, f"Sym{ax}"} & sym_names)
     # Our own --emit-tlc artifacts declare the constraint/view this checker
     # builds in; anything else would be silently unchecked.
     if [c for c in cfg.constraints if c != "StateConstraint"]:
@@ -286,7 +287,8 @@ def main(argv=None) -> int:
               f"voterLog/mlog) carried; elections capacity {b.max_elections}")
     print(f"Invariants: {', '.join(config.invariants) or '(none)'}")
     if config.symmetry:
-        print("Symmetry: Server permutations (counting orbits)")
+        print(f"Symmetry: {' x '.join(config.symmetry)} permutations "
+              "(counting orbits)")
 
     if args.emit_tlc:
         from raft_tla_tpu.models import tla_export
@@ -294,7 +296,7 @@ def main(argv=None) -> int:
             tla, cfgp = tla_export.export(args.emit_tlc, b,
                                           config.invariants,
                                           parity_view=not b.history,
-                                          symmetry=bool(config.symmetry))
+                                          symmetry=config.symmetry)
         except (OSError, ValueError) as e:
             print(f"Error: {e}", file=sys.stderr)
             return EXIT_ERROR

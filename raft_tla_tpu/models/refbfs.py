@@ -53,7 +53,8 @@ def check(config: CheckConfig, max_states: int | None = None,
     invs = [(nm, invariants.py_invariant(nm)) for nm in config.invariants]
     if config.symmetry:
         from raft_tla_tpu.ops import symmetry as sym_mod
-        keyf = lambda s: sym_mod.py_orbit_fingerprint(s, bounds)  # noqa: E731
+        keyf = lambda s: sym_mod.py_orbit_fingerprint(  # noqa: E731
+            s, bounds, config.symmetry)
     else:
         keyf = lambda s: s                                        # noqa: E731
     t0 = time.monotonic()

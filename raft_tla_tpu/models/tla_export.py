@@ -139,9 +139,11 @@ StateConstraint ==
     if parity_view:
         parts += [_PARITY_VIEW, ""]
     if symmetry:
+        axes = ("Server",) if symmetry is True else tuple(symmetry)
+        union = " \\cup ".join(f"Permutations({ax})" for ax in axes)
         parts += ["\\* TLC symmetry set matching the checker's "
-                  "--symmetry reduction.",
-                  "SymServer == Permutations(Server)", ""]
+                  "symmetry reduction.",
+                  f"SymSet == {union}", ""]
     parts.append("=" * 77)
     return "\n".join(parts)
 
@@ -157,7 +159,7 @@ def emit_cfg(bounds: Bounds, invariants: tuple,
         *[f"INVARIANT {nm}" for nm in invariants],
         "CONSTRAINT StateConstraint",
         *(["VIEW ParityView"] if parity_view else []),
-        *(["SYMMETRY SymServer"] if symmetry else []),
+        *(["SYMMETRY SymSet"] if symmetry else []),
         "",
         "CONSTANTS",
         f"    Server = {{{servers}}}",

@@ -536,7 +536,8 @@ def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
         svecs = jax.vmap(jax.vmap(lambda t: st.pack(t, jnp)))(succs)
         if symmetry:
             fp_hi, fp_lo = jax.vmap(jax.vmap(
-                lambda t: sym.orbit_fingerprint(t, bounds, consts, jnp))
+                lambda t: sym.orbit_fingerprint(t, bounds, consts, jnp,
+                                symmetry))
             )(succs)
         else:
             fp_hi, fp_lo = fpr.fingerprint(svecs, consts, jnp)

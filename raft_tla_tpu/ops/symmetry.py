@@ -27,9 +27,14 @@ Permuting one state under ``p`` (new index of old server j is ``p[j]``):
   (occupied slots only — empty slots stay all-zero), then the bag
   re-canonicalizes (sort order may change under renaming).
 
-``Value`` symmetry is not implemented this round (the reference cfg names
-no SYMMETRY at all; Server is the axis the state space actually explodes
-in).
+``Value`` symmetry (TLC's ``Permutations(Value)``) composes: values have no
+distinguished elements in the spec (they only enter through ``ClientRequest``
+and flow inertly through logs and ``mentries``), so the orbit key may also
+minimize over value permutations.  Permuting values remaps ``logVal``
+contents, the message entry-value field, and — in faithful mode — every
+log-universe rank (``ops/loguniv.py``) through a precomputed static
+rank-permutation table (allLogs bitmasks permute bitwise).  The full orbit
+pass is then ``n! * V!`` static transforms.
 """
 
 from __future__ import annotations
@@ -55,6 +60,86 @@ def permutations(bounds: Bounds) -> tuple:
             f"(got {bounds.n_servers}: {math.factorial(bounds.n_servers)}"
             " permutations)")
     return tuple(itertools.permutations(range(bounds.n_servers)))
+
+
+MAX_SYM_VALUES = 5       # 120 value permutations
+
+
+def value_permutations(bounds: Bounds) -> tuple:
+    if bounds.n_values > MAX_SYM_VALUES:
+        raise ValueError(
+            f"Value symmetry supports at most {MAX_SYM_VALUES} values "
+            f"(got {bounds.n_values})")
+    return tuple(itertools.permutations(range(bounds.n_values)))
+
+
+@functools.lru_cache(maxsize=None)
+def _rank_maps(bounds: Bounds) -> tuple:
+    """Per value-permutation q: int32[U] mapping each log rank to the rank
+    of the value-permuted log (faithful mode; identity-permutation first)."""
+    from raft_tla_tpu.ops.loguniv import LogUniverse
+    uni = LogUniverse.of(bounds)
+    maps = []
+    for q in value_permutations(bounds):
+        m = np.empty((uni.size,), np.int32)
+        for r in range(uni.size):
+            log = uni.tuple_of_id(r)
+            m[r] = uni.id_of_tuple(tuple((t, q[v - 1] + 1) for t, v in log))
+        maps.append(m)
+    return tuple(maps)
+
+
+def permute_values(struct: dict, qi: int, bounds: Bounds, xp) -> dict:
+    """Apply the ``qi``-th value permutation to one state struct.
+
+    Remaps ``logVal`` contents (0 = padding fixed), the message entry-value
+    field ``e`` (zero for every non-AppendEntriesRequest record, and the
+    LUT fixes 0), and in faithful mode every log rank through the static
+    rank table — ``allLogs`` permutes bitwise.
+    """
+    q = value_permutations(bounds)[qi]
+    V = bounds.n_values
+    vlut = xp.asarray((0,) + tuple(q[v - 1] + 1 for v in range(1, V + 1)))
+    out = dict(struct)
+    out["logVal"] = vlut[struct["logVal"]]
+    e_sh, e_w = mb._LO_FIELDS["e"]
+    lo = struct["msgLo"]
+    e_lut = xp.asarray((0,) + tuple(q[v - 1] + 1 for v in range(1, V + 1))
+                       + tuple(0 for _ in range((1 << e_w) - V - 1)))
+    new_lo = (lo & ~(((1 << e_w) - 1) << e_sh)) \
+        | (e_lut[(lo >> e_sh) & ((1 << e_w) - 1)] << e_sh)
+    if "allLogs" in struct:
+        rmap = xp.asarray(_rank_maps(bounds)[qi])
+        U = int(rmap.shape[0])
+        rlut1 = xp.concatenate([xp.zeros((1,), xp.int32),
+                                rmap.astype(xp.int32) + 1])  # rank+1 form
+        out["vLog"] = rlut1[struct["vLog"]]
+        out["eLog"] = rmap[struct["eLog"]]
+        out["eVLog"] = rlut1[struct["eVLog"]]
+        # mlog rank rides the g field of the lo word
+        g_sh, g_w = mb._LO_FIELDS["g"]
+        g_lut = xp.concatenate(
+            [rmap.astype(xp.int32),
+             xp.zeros(((1 << g_w) - U,), xp.int32)])
+        new_lo = (new_lo & ~(((1 << g_w) - 1) << g_sh)) \
+            | (g_lut[(new_lo >> g_sh) & ((1 << g_w) - 1)] << g_sh)
+        # allLogs: bit r of the old mask becomes bit rmap[r] of the new
+        # one.  Contributions within a word are distinct bit positions, so
+        # an integer sum IS the bitwise OR.  Bits 0..30 sum safely in
+        # int32; the sign bit is OR'd in separately (no x64 under jit).
+        rs = xp.arange(U)
+        bits = ((struct["allLogs"][rs // 32] >> (rs % 32)) & 1)
+        Wa = struct["allLogs"].shape[0]
+        in_word = (rmap[None, :] // 32) == xp.arange(Wa)[:, None]  # [Wa, U]
+        tb = rmap[None, :] % 32
+        low = xp.where(in_word & (tb < 31) & (bits[None, :] > 0),
+                       xp.asarray(1, xp.int32) << tb, 0).sum(axis=1)
+        top = (in_word & (tb == 31) & (bits[None, :] > 0)).any(axis=1)
+        out["allLogs"] = (low.astype(xp.int32)
+                          | xp.where(top, xp.asarray(-2**31, xp.int32), 0))
+    occupied = struct["msgCount"] > 0
+    out["msgLo"] = xp.where(occupied, new_lo, struct["msgLo"])
+    return out
 
 
 def permute_struct(struct: dict, p: tuple, bounds: Bounds, xp) -> dict:
@@ -124,18 +209,26 @@ def permute_struct(struct: dict, p: tuple, bounds: Bounds, xp) -> dict:
     return out
 
 
-def orbit_fingerprint(struct: dict, bounds: Bounds, consts, xp):
-    """Orbit-minimal (hi, lo) fingerprint of one canonical state struct."""
+def orbit_fingerprint(struct: dict, bounds: Bounds, consts, xp,
+                      axes: tuple = ("Server",)):
+    """Orbit-minimal (hi, lo) fingerprint of one canonical state struct,
+    minimized over the permutation group of the named ``axes``."""
+    sperms = permutations(bounds) if "Server" in axes \
+        else (tuple(range(bounds.n_servers)),)
+    vqs = range(len(value_permutations(bounds))) if "Value" in axes else (0,)
     best_hi = best_lo = None
-    for p in permutations(bounds):
-        t = st.canonicalize(permute_struct(struct, p, bounds, xp), xp)
-        hi, lo = fpr.fingerprint(st.pack(t, xp), consts, xp)
-        if best_hi is None:
-            best_hi, best_lo = hi, lo
-        else:
-            take = (hi < best_hi) | ((hi == best_hi) & (lo < best_lo))
-            best_hi = xp.where(take, hi, best_hi)
-            best_lo = xp.where(take, lo, best_lo)
+    for p in sperms:
+        ps = permute_struct(struct, p, bounds, xp)
+        for qi in vqs:
+            t = permute_values(ps, qi, bounds, xp) if "Value" in axes else ps
+            t = st.canonicalize(t, xp)
+            hi, lo = fpr.fingerprint(st.pack(t, xp), consts, xp)
+            if best_hi is None:
+                best_hi, best_lo = hi, lo
+            else:
+                take = (hi < best_hi) | ((hi == best_hi) & (lo < best_lo))
+                best_hi = xp.where(take, hi, best_hi)
+                best_lo = xp.where(take, lo, best_lo)
     return best_hi, best_lo
 
 
@@ -146,13 +239,15 @@ def _host_consts(width: int) -> np.ndarray:
     return fpr.lane_constants(width)
 
 
-def py_orbit_fingerprint(s, bounds: Bounds) -> tuple:
+def py_orbit_fingerprint(s, bounds: Bounds,
+                         axes: tuple = ("Server",)) -> tuple:
     """Oracle-side orbit key of a PyState — same arithmetic, NumPy."""
     from raft_tla_tpu.models import interp
 
     lay = st.Layout.of(bounds)
     struct = st.unpack(interp.to_vec(s, bounds), lay, np)
-    hi, lo = orbit_fingerprint(struct, bounds, _host_consts(lay.width), np)
+    hi, lo = orbit_fingerprint(struct, bounds, _host_consts(lay.width), np,
+                               axes)
     return int(hi), int(lo)
 
 
@@ -160,7 +255,7 @@ def init_fingerprint(config, init_py, init_vec) -> tuple:
     """The dedup key of the initial state, orbit-reduced when the run has
     SYMMETRY — one definition for every engine's table seeding."""
     if config.symmetry:
-        return py_orbit_fingerprint(init_py, config.bounds)
+        return py_orbit_fingerprint(init_py, config.bounds, config.symmetry)
     consts = _host_consts(init_vec.shape[-1])
     hi, lo = fpr.fingerprint(init_vec.astype(np.int32), consts, np)
     return int(hi), int(lo)

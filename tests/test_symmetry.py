@@ -153,3 +153,47 @@ def test_host_engine_symmetry_parity():
     got = engine.check(cfg)
     assert got.n_states == ref.n_states == 1514
     assert got.levels == ref.levels
+
+
+def test_value_symmetry_orbit_counts():
+    """Value permutations (TLC Permutations(Value)) quotient further:
+    values enter only through ClientRequest and flow inertly, so
+    Server x Value orbits < Server orbits < raw states, same diameter."""
+    bp = Bounds(n_servers=2, n_values=2, max_term=2, max_log=1, max_msgs=2)
+
+    def run(axes):
+        return refbfs.check(CheckConfig(bounds=bp, spec="full",
+                                        invariants=(), symmetry=axes))
+    base, s_only, v_only, sv = (run(()), run(("Server",)), run(("Value",)),
+                                run(("Server", "Value")))
+    assert base.n_states == 74897
+    assert (s_only.n_states, v_only.n_states, sv.n_states) == \
+        (37472, 50515, 25281)
+    assert base.diameter == s_only.diameter == v_only.diameter == sv.diameter
+
+
+def test_value_symmetry_engine_parity():
+    from raft_tla_tpu import engine
+    bp = Bounds(n_servers=2, n_values=2, max_term=2, max_log=1, max_msgs=2)
+    cfg = CheckConfig(bounds=bp, spec="full", invariants=("NoTwoLeaders",),
+                      symmetry=("Server", "Value"), chunk=512)
+    ref = refbfs.check(cfg)
+    got = engine.check(cfg)
+    assert (got.n_states, got.diameter) == (ref.n_states, ref.diameter)
+    assert got.coverage == ref.coverage and got.violation is None
+
+
+def test_value_symmetry_faithful_mode():
+    """Rank-table remaps + bitwise allLogs permutation: faithful spaces
+    quotient under Server x Value too, engines in exact agreement."""
+    from raft_tla_tpu import engine
+    bh = Bounds(n_servers=2, n_values=2, max_term=2, max_log=1, max_msgs=2,
+                history=True, max_elections=4)
+    cf = CheckConfig(bounds=bh, spec="full",
+                     invariants=("NoTwoLeaders", "ElectionSafetyHist"),
+                     symmetry=("Server", "Value"), chunk=512)
+    ref = refbfs.check(cf)
+    got = engine.check(cf)
+    assert (ref.n_states, ref.diameter) == (28121, 32)  # of 84572 states
+    assert (got.n_states, got.diameter) == (28121, 32)
+    assert ref.violation is None and got.violation is None
