@@ -219,3 +219,43 @@ def test_symmetry_composes_with_faithful_mode():
     assert rf.violation is None
     ef = engine.check(cf)
     assert (ef.n_states, ef.diameter) == (26723, 32)
+
+
+def test_device_and_paged_engines_faithful_parity():
+    """The flagship engines run faithful mode too: HBM store rows and the
+    paged engine's bit-packed rows both carry the history fields."""
+    from raft_tla_tpu.device_engine import Capacities, DeviceEngine
+    from raft_tla_tpu.paged_engine import PagedCapacities, PagedEngine
+    cc = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                   max_log=1, max_msgs=2, history=True,
+                                   max_elections=4),
+                     spec="full",
+                     invariants=("NoTwoLeaders", "ElectionSafetyHist",
+                                 "AllLogsPrefixClosed"), chunk=512)
+    ref = refbfs.check(cc)
+    assert (ref.n_states, ref.diameter) == (53398, 32)
+    dev = DeviceEngine(cc, Capacities(n_states=1 << 16, levels=64)).check()
+    assert (dev.n_states, dev.diameter) == (ref.n_states, ref.diameter)
+    assert dev.levels == ref.levels and dev.coverage == ref.coverage
+    pag = PagedEngine(cc, PagedCapacities(ring=1 << 16, table=1 << 18,
+                                          levels=64)).check()
+    assert (pag.n_states, pag.diameter) == (ref.n_states, ref.diameter)
+    assert pag.levels == ref.levels and pag.coverage == ref.coverage
+
+
+def test_bitpack_roundtrip_history_fields():
+    """Bit-packed rows preserve every faithful-mode field exactly,
+    including the 32-bit allLogs words (sign bit included)."""
+    from raft_tla_tpu.ops import bitpack
+    rng = np.random.default_rng(5)
+    sch = bitpack.BitSchema(BH)
+    vecs = np.stack([
+        interp.to_vec(random_pystate(rng, BH), BH) for _ in range(64)])
+    # force sign-bit patterns into the allLogs words
+    lay = st.Layout.of(BH)
+    off = sum(int(np.prod(lay.shapes[f])) for f in st.STATE_FIELDS)
+    vecs[0, off] = -2147483648
+    vecs[1, off] = -1
+    packed = sch.pack(vecs, np)
+    assert packed.shape[-1] == sch.P < vecs.shape[-1]
+    assert (sch.unpack(packed, np) == vecs).all()
