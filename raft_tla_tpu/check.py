@@ -132,6 +132,13 @@ def _resolve_config(args):
         raise ValueError(
             f"unsupported SPECIFICATION {cfg.specification!r}: the compiled "
             "model implements Spec == Init /\\ [][Next]_vars (raft.tla:469)")
+    # INIT/NEXT-style configs: only the spec's own operators are compiled;
+    # any other name would silently run a different model.
+    if cfg.init not in (None, "Init") or cfg.next not in (None, "Next"):
+        raise ValueError(
+            f"unsupported INIT/NEXT ({cfg.init!r}/{cfg.next!r}): only the "
+            "spec's Init (raft.tla:155-160) and Next (raft.tla:454-465) "
+            "are compiled")
     unknown = [nm for nm in cfg.invariants if nm not in inv_mod.REGISTRY]
     if unknown:
         raise ValueError(

@@ -235,3 +235,19 @@ def test_cli_faithful_rejects_parity_view(tmp_path):
     assert code == cli.EXIT_OK
     code, _ = run_cli(cfg, "--engine", "ref", "--faithful", *tiny)
     assert code == cli.EXIT_ERROR
+
+
+def test_cli_init_next_stanzas(tmp_path):
+    """INIT/NEXT-style configs: the spec's own operator names pass, any
+    other name is rejected (it would silently run a different model)."""
+    tiny = ("--spec", "election", "--max-term", "2", "--max-log", "0",
+            "--max-msgs", "1")
+    template = open(write_cfg(tmp_path / "t.cfg")).read()
+    (tmp_path / "a.cfg").write_text(
+        template.replace("SPECIFICATION Spec", "INIT Init\nNEXT Next"))
+    code, _ = run_cli(str(tmp_path / "a.cfg"), "--engine", "ref", *tiny)
+    assert code == cli.EXIT_OK
+    (tmp_path / "b.cfg").write_text(
+        template.replace("SPECIFICATION Spec", "NEXT LiveNext"))
+    code, _ = run_cli(str(tmp_path / "b.cfg"), "--engine", "ref", *tiny)
+    assert code == cli.EXIT_ERROR
