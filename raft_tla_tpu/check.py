@@ -102,12 +102,13 @@ def build_argparser() -> argparse.ArgumentParser:
                         "relation; 'none' = no fairness, the reference "
                         "spec's actual Spec, raft.tla:469)")
     p.add_argument("--checkpoint", metavar="PATH",
-                   help="periodically snapshot the search (device engine); "
-                        "resume later with --resume")
+                   help="periodically snapshot the search (device/paged/"
+                        "shard engines); resume later with --resume")
     p.add_argument("--checkpoint-every", type=float, default=120.0,
                    metavar="SECONDS")
     p.add_argument("--resume", metavar="PATH",
-                   help="resume a --checkpoint snapshot (device engine)")
+                   help="resume a --checkpoint snapshot (device/paged/"
+                        "shard engines)")
     p.add_argument("--no-trace", action="store_true",
                    help="suppress the counterexample trace on violation")
     p.add_argument("--coverage", action="store_true",
@@ -118,7 +119,7 @@ def build_argparser() -> argparse.ArgumentParser:
                         "cfg SYMMETRY stanza)")
     p.add_argument("--stats", action="store_true",
                    help="emit one JSON line of run stats per search segment "
-                        "on stderr (device/paged engines)")
+                        "on stderr (device/paged/shard engines)")
     return p
 
 

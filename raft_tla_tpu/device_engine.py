@@ -527,11 +527,11 @@ class DeviceEngine:
                 on_progress(_progress_stats(carry, t0))
             if bool(done):
                 break
+            dt = time.monotonic() - t_seg
             if checkpoint and (time.monotonic() - last_ckpt
                                >= checkpoint_every_s):
                 self.save_checkpoint(checkpoint, carry, (hi0, lo0))
                 last_ckpt = time.monotonic()
-            dt = time.monotonic() - t_seg
             if not first and dt > 0.05:
                 # In the run's cheap tail (tiny ragged levels) the budget
                 # ramps geometrically; the next wide level would then run

@@ -379,12 +379,12 @@ class PagedEngine:
                 on_progress(_progress_stats(carry, t0))
             if bool(done):
                 break
+            dt = time.monotonic() - t_seg
             if checkpoint and (time.monotonic() - last_ckpt
                                >= checkpoint_every_s):
                 self.save_checkpoint(checkpoint, carry, host, paged,
                                      (hi0, lo0))
                 last_ckpt = time.monotonic()
-            dt = time.monotonic() - t_seg
             if not first and dt > 0.05:
                 worst_s_per_chunk = max(worst_s_per_chunk, dt / budget)
                 scale = min(2.0, max(0.25, self.SEG_TARGET_S / dt))

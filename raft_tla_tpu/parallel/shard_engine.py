@@ -481,11 +481,11 @@ class ShardEngine:
                 on_progress(self._progress_stats(carry, t0))
             if bool(np.asarray(carry.stop)):
                 break
+            dt = time.monotonic() - t_seg
             if checkpoint and (time.monotonic() - last_ckpt
                                >= checkpoint_every_s):
                 self.save_checkpoint(checkpoint, carry, (hi0, lo0))
                 last_ckpt = time.monotonic()
-            dt = time.monotonic() - t_seg
             if not first and dt > 0.05:
                 # Same watchdog clamp as DeviceEngine.check: never project a
                 # segment past SEG_CLAMP_S at the worst chunk cost seen.

@@ -49,9 +49,13 @@ def _stable(obj):
 
 
 def config_digest(config, caps, init_key: tuple) -> int:
+    # check_deadlock joins the identity only when on (default-omission, like
+    # _stable): resuming a non-deadlock checkpoint under --deadlock would
+    # silently skip dead states in the already-explored region.
+    extras = (("check_deadlock", True),) if config.check_deadlock else ()
     key = repr((_stable(config.bounds), config.spec, config.invariants,
                 config.symmetry, config.chunk, _stable(caps),
-                init_key)).encode()
+                init_key, *extras)).encode()
     return int.from_bytes(hashlib.sha256(key).digest()[:8], "big")
 
 

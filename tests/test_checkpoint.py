@@ -127,3 +127,16 @@ def test_shard_checkpoint_resume_bit_exact(tmp_path):
 
     with pytest.raises(ValueError, match="checkpoint"):
         eng(4).check(resume=ck)
+
+
+def test_digest_covers_deadlock_toggle():
+    """Resuming a non-deadlock checkpoint under --deadlock would silently
+    skip dead states in the explored region (review finding); the digest
+    must split on the toggle — but stay stable when it is off (default
+    omission keeps old checkpoints valid)."""
+    import dataclasses
+    from raft_tla_tpu.utils import ckpt
+    base = ckpt.config_digest(CFG, CAPS, (1, 2))
+    on = ckpt.config_digest(dataclasses.replace(CFG, check_deadlock=True),
+                            CAPS, (1, 2))
+    assert base != on
