@@ -120,6 +120,18 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--stats", action="store_true",
                    help="emit one JSON line of run stats per search segment "
                         "on stderr (device/paged/shard engines)")
+    p.add_argument("--simulate", type=int, metavar="N", default=None,
+                   help="TLC -simulate analog: instead of exhaustive "
+                        "search, sample N random behaviors (batched "
+                        "walkers on device), invariants checked on every "
+                        "generated state")
+    p.add_argument("--depth", type=int, default=100,
+                   help="--simulate: maximum behavior length (TLC's "
+                        "-depth; default 100)")
+    p.add_argument("--walkers", type=int, default=1024,
+                   help="--simulate: parallel walkers per device step")
+    p.add_argument("--seed", type=int, default=0,
+                   help="--simulate: PRNG seed (same seed = same walks)")
     return p
 
 
@@ -208,6 +220,31 @@ def _stats_cb(args):
     def cb(stats):
         print(json.dumps(stats), file=sys.stderr)
     return cb
+
+
+def _simulate(args, config):
+    """TLC -simulate analog; returns a TLC-compatible exit code."""
+    from raft_tla_tpu.engine import DEADLOCK
+    from raft_tla_tpu.simulate import Simulator
+    sim = Simulator(config, walkers=args.walkers, depth=args.depth,
+                    seed=args.seed)
+    res = sim.run(args.simulate)
+    print(f"{res.n_behaviors} behaviors generated ({res.n_states} states, "
+          f"deepest {res.max_depth_seen}), {res.wall_s:.2f}s "
+          f"({res.states_per_sec:,.0f} states/s).")
+    if res.violation is None:
+        print("Model checking completed. No error has been found.")
+        print(f"  (simulation: {args.simulate} behaviors of depth "
+              f"<= {args.depth}; not exhaustive)")
+        return EXIT_OK
+    is_deadlock = res.violation.invariant == DEADLOCK
+    if args.no_trace:
+        print("Error: Deadlock reached." if is_deadlock else
+              f"Error: Invariant {res.violation.invariant} is violated.")
+    else:
+        from raft_tla_tpu.utils.render import render_trace
+        print(render_trace(res.violation, config.bounds))
+    return EXIT_DEADLOCK if is_deadlock else EXIT_VIOLATION
 
 
 def _run(args, config):
@@ -309,6 +346,16 @@ def main(argv=None) -> int:
             print(f"Error: {e}", file=sys.stderr)
             return EXIT_ERROR
         print(f"TLC parity artifacts: {tla}, {cfgp}")
+
+    if args.simulate is not None:
+        if args.cpu:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        try:
+            return _simulate(args, config)
+        except Exception as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return EXIT_ERROR
 
     t0 = time.monotonic()
     try:

@@ -1,0 +1,249 @@
+"""Simulation mode — TLC's ``-simulate``, the TPU way (SURVEY §0: TLC is
+the runtime whose capabilities this framework replicates).
+
+Where exhaustive BFS enumerates the bounded state space, simulation mode
+samples random *behaviors*: walks from ``Init`` taking uniformly-random
+enabled actions, invariants checked on every generated state, up to a depth
+bound per behavior, restarting until a behavior quota is met or a violation
+is found.  TLC runs one walker; here a **batch of walkers advances in
+lockstep inside one jitted segment** — each step vmaps the fused action
+expansion (ops/kernels.build_expand) over the whole batch, samples one
+enabled lane per walker with ``jax.random``, and records the lane into a
+per-walker history ring so a violating walk replays exactly.
+
+Behavior-end rules (TLC semantics):
+
+- **depth bound reached** — behavior complete, walker resets to Init;
+- **no enabled action** — with ``check_deadlock`` the run aborts with the
+  walk as counterexample (exit 11 at the CLI); otherwise the behavior
+  completes and the walker resets;
+- **StateConstraint violation** — the successor is still generated and
+  invariant-checked (CONSTRAINT gates exploration, not generation), then
+  the behavior ends and the walker resets;
+- **invariant violation** — the run stops; the trace is reconstructed by
+  replaying the recorded lane history through the reference interpreter
+  (models/interp.py), so the reported behavior is exact, not approximate.
+
+Determinism: one ``jax.random`` key drives everything; the same seed,
+batch size and depth reproduce the same walks bit for bit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import CheckConfig
+from raft_tla_tpu.engine import DEADLOCK, Violation
+from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
+from raft_tla_tpu.ops import kernels
+from raft_tla_tpu.ops import state as st
+
+I32 = jnp.int32
+
+
+@dataclasses.dataclass
+class SimResult:
+    n_behaviors: int       # completed behaviors (depth/constraint-ended)
+    n_states: int          # states generated (not deduplicated)
+    max_depth_seen: int
+    violation: Optional[Violation]
+    wall_s: float
+
+    @property
+    def states_per_sec(self) -> float:
+        return self.n_states / self.wall_s if self.wall_s > 0 else float("inf")
+
+
+def _build_sim_segment(config: CheckConfig, walkers: int, depth: int,
+                       steps: int, W: int, A: int):
+    """One jitted dispatch: advance every walker by up to ``steps`` steps."""
+    bounds = config.bounds
+    n_inv = len(config.invariants)
+    expand = kernels.build_expand(bounds, config.spec)
+    inv_fns = [inv_mod.jnp_invariant(nm, bounds) for nm in config.invariants]
+    lay = st.Layout.of(bounds)
+    BIG = jnp.int32(np.iinfo(np.int32).max)
+
+    def one_step(carry, key, init_vec):
+        vecs, hist, hlen, n_beh, n_st, maxd, viol_w, viol_i, dead_w = carry
+        structs = jax.vmap(lambda v: st.unpack(v, lay, jnp))(vecs)
+        succs, valid, _ovf = jax.vmap(expand)(structs)      # [B, A, ...]
+        svecs = jax.vmap(jax.vmap(lambda t: st.pack(t, jnp)))(succs)
+
+        # sample one enabled lane per walker (uniform over enabled)
+        logits = jnp.where(valid, 0.0, -jnp.inf)
+        lane = jax.random.categorical(key, logits, axis=-1).astype(I32)
+        enabled = jnp.any(valid, axis=-1)                   # [B]
+        lane = jnp.where(enabled, lane, 0)
+        pick = jnp.take_along_axis(
+            svecs, lane[:, None, None], axis=1)[:, 0]       # [B, W]
+        pick_s = jax.vmap(lambda v: st.unpack(v, lay, jnp))(pick)
+        con_ok = jax.vmap(lambda t: st.constraint_ok(t, bounds, jnp))(pick_s)
+        if inv_fns:
+            inv_ok = jnp.stack([jax.vmap(f)(pick_s) for f in inv_fns],
+                               axis=-1)                     # [B, nI]
+        else:
+            inv_ok = jnp.ones((walkers, 0), bool)
+
+        # deadlock: current state has no successor at all
+        first_dead = jnp.min(jnp.where(
+            ~enabled, jnp.arange(walkers, dtype=I32), BIG))
+        new_dead = (first_dead < BIG) & (dead_w < 0) if config.check_deadlock \
+            else jnp.bool_(False)
+        dead_w = jnp.where(new_dead, first_dead, dead_w)
+
+        # invariant violation among stepped walkers
+        bad = enabled & jnp.any(~inv_ok, axis=-1)
+        first_bad = jnp.min(jnp.where(bad, jnp.arange(walkers, dtype=I32),
+                                      BIG))
+        new_viol = (first_bad < BIG) & (viol_w < 0)
+        viol_w = jnp.where(new_viol, first_bad, viol_w)
+        bidx = jnp.minimum(first_bad, walkers - 1)
+        viol_i = jnp.where(
+            new_viol,
+            jnp.argmax(~inv_ok[bidx], axis=-1).astype(I32) if n_inv
+            else jnp.int32(0),
+            viol_i)
+
+        # record the step for walkers that moved
+        hist = jnp.where(
+            (enabled[:, None]) & (jnp.arange(depth)[None, :] == hlen[:, None]),
+            lane[:, None], hist)
+        hlen2 = jnp.where(enabled, hlen + 1, hlen)
+        maxd = jnp.maximum(maxd, jnp.max(hlen2))
+        n_st = n_st + jnp.sum(enabled.astype(I32))
+
+        # behavior end: depth bound, constraint-violating successor, or
+        # (without check_deadlock) a stuck walker; violating walkers FREEZE
+        # so their history stays replayable.
+        frozen = (jnp.arange(walkers, dtype=I32) == viol_w) & (viol_w >= 0) \
+            | ((jnp.arange(walkers, dtype=I32) == dead_w) & (dead_w >= 0))
+        done = (~frozen) & (enabled & (~con_ok | (hlen2 >= depth))
+                            | ~enabled)
+        n_beh = n_beh + jnp.sum(done.astype(I32))
+        init_b = jnp.broadcast_to(init_vec, (walkers, W))
+        vecs2 = jnp.where(frozen[:, None], vecs,
+                          jnp.where(done[:, None], init_b,
+                                    jnp.where(enabled[:, None], pick, vecs)))
+        hlen3 = jnp.where(frozen, hlen2, jnp.where(done, 0, hlen2))
+        # freeze the violating walker's successor (for completeness we keep
+        # the pre-violation vec; the trace replays from history anyway)
+        stop = (viol_w >= 0) | (dead_w >= 0)
+        return (vecs2, hist, hlen3, n_beh, n_st, maxd, viol_w, viol_i,
+                dead_w), stop
+
+    def segment(key, init_vec, vecs, hist, hlen, n_beh, n_st, maxd):
+        viol_w = jnp.int32(-1)
+        viol_i = jnp.int32(0)
+        dead_w = jnp.int32(-1)
+        keys = jax.random.split(key, steps)
+
+        def body(i, carry):
+            state, stopped = carry
+
+            def advance(_):
+                return one_step(state, keys[i], init_vec)
+            return jax.lax.cond(stopped, lambda _: (state, stopped),
+                                advance, None)
+
+        carry = ((vecs, hist, hlen, n_beh, n_st, maxd, viol_w, viol_i,
+                  dead_w), jnp.bool_(False))
+        stfin, _stop = jax.lax.fori_loop(0, steps, body, carry)
+        return stfin
+
+    return segment
+
+
+class Simulator:
+    """Batched random-behavior generator for one :class:`CheckConfig`."""
+
+    def __init__(self, config: CheckConfig, walkers: int = 1024,
+                 depth: int = 100, steps_per_dispatch: int = 64,
+                 seed: int = 0):
+        if config.symmetry:
+            raise ValueError("simulation mode ignores SYMMETRY; run without")
+        self.config = config
+        self.bounds = config.bounds
+        self.lay = st.Layout.of(self.bounds)
+        self.table = S.action_table(self.bounds, config.spec)
+        self.A = len(self.table)
+        self.walkers = walkers
+        self.depth = depth
+        self.steps = steps_per_dispatch
+        self.seed = seed
+        self._segment = jax.jit(_build_sim_segment(
+            config, walkers, depth, self.steps, self.lay.width, self.A))
+
+    def run(self, n_behaviors: int,
+            init_override: interp.PyState | None = None,
+            max_wall_s: float | None = None) -> SimResult:
+        t0 = time.monotonic()
+        bounds = self.bounds
+        init_py = init_override if init_override is not None \
+            else interp.init_state(bounds)
+        init_vec = interp.to_vec(init_py, bounds)
+        for nm in self.config.invariants:
+            if not inv_mod.py_invariant(nm)(init_py, bounds):
+                return SimResult(0, 1, 0,
+                                 Violation(nm, init_py, [(None, init_py)]),
+                                 time.monotonic() - t0)
+        iv = jnp.asarray(init_vec, I32)
+
+        key = jax.random.PRNGKey(self.seed)
+        vecs = jnp.broadcast_to(jnp.asarray(init_vec, I32),
+                                (self.walkers, self.lay.width))
+        hist = jnp.zeros((self.walkers, self.depth), I32)
+        hlen = jnp.zeros((self.walkers,), I32)
+        n_beh = jnp.int32(0)
+        n_st = jnp.int32(0)
+        maxd = jnp.int32(0)
+        while True:
+            key, sub = jax.random.split(key)
+            (vecs, hist, hlen, n_beh, n_st, maxd, viol_w, viol_i,
+             dead_w) = self._segment(sub, iv, vecs, hist, hlen, n_beh,
+                                     n_st, maxd)
+            vw, dw = int(viol_w), int(dead_w)
+            if vw >= 0 or dw >= 0:
+                w = vw if vw >= 0 else dw
+                name = DEADLOCK if dw >= 0 else \
+                    self.config.invariants[int(viol_i)]
+                trace = self._replay(init_py, np.asarray(hist[w]),
+                                     int(hlen[w]))
+                return SimResult(
+                    n_behaviors=int(n_beh), n_states=int(n_st),
+                    max_depth_seen=int(maxd),
+                    violation=Violation(name, trace[-1][1], trace),
+                    wall_s=time.monotonic() - t0)
+            if int(n_beh) >= n_behaviors:
+                break
+            if max_wall_s is not None and \
+                    time.monotonic() - t0 > max_wall_s:
+                break
+        return SimResult(n_behaviors=int(n_beh), n_states=int(n_st),
+                         max_depth_seen=int(maxd), violation=None,
+                         wall_s=time.monotonic() - t0)
+
+    def _replay(self, init_py, lanes: np.ndarray, hlen: int) -> list:
+        """Rebuild the violating walk exactly through the interpreter."""
+        chain = [(None, init_py)]
+        cur = init_py
+        for k in range(hlen):
+            a = self.table[int(lanes[k])]
+            nxt = interp.apply_action(cur, a, self.bounds)
+            assert nxt is not None, "recorded lane must be enabled on replay"
+            chain.append((a.label(), nxt))
+            cur = nxt
+        return chain
+
+
+def simulate(config: CheckConfig, n_behaviors: int = 1000, **kw) -> SimResult:
+    """One-shot convenience mirroring the engines' ``check``."""
+    run_kw = {k: kw.pop(k) for k in ("init_override", "max_wall_s")
+              if k in kw}
+    return Simulator(config, **kw).run(n_behaviors, **run_kw)

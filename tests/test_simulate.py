@@ -1,0 +1,106 @@
+"""Simulation mode (TLC -simulate): batched random behaviors on device.
+
+Random walks from Init with invariants checked on every generated state;
+violating walks replay exactly through the reference interpreter.
+"""
+
+import pytest
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.engine import DEADLOCK
+from raft_tla_tpu.models import interp, spec as S
+from raft_tla_tpu.ops import msgbits as mb
+from raft_tla_tpu.simulate import Simulator
+
+
+def bag(*ms):
+    return tuple(sorted((m, 1) for m in ms))
+
+
+B3 = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0, max_msgs=4)
+CV = CheckConfig(bounds=B3, spec="election",
+                 invariants=("NaiveNoTwoLeaders",))
+
+
+def seeded_start():
+    """Two steps from a NaiveNoTwoLeaders violation (engine-test seed)."""
+    return interp.init_state(B3)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3), votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100), msgs=bag(mb.rv_response(3, 1, 1, 2)))
+
+
+def test_clean_run_counts_behaviors():
+    cc = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                   max_log=1, max_msgs=2),
+                     spec="full", invariants=("NoTwoLeaders",))
+    sim = Simulator(cc, walkers=128, depth=40, steps_per_dispatch=32, seed=1)
+    r = sim.run(500)
+    assert r.violation is None
+    assert r.n_behaviors >= 500
+    assert r.n_states >= r.n_behaviors          # every behavior took steps
+    assert 0 < r.max_depth_seen <= 40
+
+
+def test_finds_violation_and_trace_replays():
+    sim = Simulator(CV, walkers=256, depth=20, steps_per_dispatch=16, seed=3)
+    r = sim.run(100000, init_override=seeded_start())
+    assert r.violation is not None
+    assert r.violation.invariant == "NaiveNoTwoLeaders"
+    tab = S.action_table(B3, "election")
+    cur = r.violation.trace[0][1]
+    for label, nxt in r.violation.trace[1:]:
+        assert nxt in {t for _a, t in interp.successors(cur, B3, tab)}, label
+        cur = nxt
+    assert sum(1 for x in cur.role if x == S.LEADER) >= 2
+    assert cur == r.violation.state
+
+
+def test_same_seed_same_walks():
+    mk = lambda: Simulator(CV, walkers=64, depth=16,        # noqa: E731
+                           steps_per_dispatch=8, seed=7)
+    r1 = mk().run(2000, init_override=seeded_start())
+    r2 = mk().run(2000, init_override=seeded_start())
+    assert r1.violation is not None and r2.violation is not None
+    assert r1.violation.trace == r2.violation.trace
+    assert (r1.n_behaviors, r1.n_states) == (r2.n_behaviors, r2.n_states)
+
+
+def test_simulation_deadlock():
+    """1-server election: every walk runs into the sole-leader dead end."""
+    cd = CheckConfig(bounds=Bounds(n_servers=1, n_values=1, max_term=2,
+                                   max_log=0, max_msgs=1),
+                     spec="election", invariants=(), check_deadlock=True)
+    r = Simulator(cd, walkers=32, depth=30, steps_per_dispatch=16,
+                  seed=0).run(1000)
+    assert r.violation is not None and r.violation.invariant == DEADLOCK
+    # the trace ends at a state with no successors
+    tab = S.action_table(cd.bounds, "election")
+    assert not list(interp.successors(r.violation.state, cd.bounds, tab))
+
+
+def test_without_deadlock_flag_walks_reset():
+    cd = CheckConfig(bounds=Bounds(n_servers=1, n_values=1, max_term=2,
+                                   max_log=0, max_msgs=1),
+                     spec="election", invariants=())
+    r = Simulator(cd, walkers=32, depth=30, steps_per_dispatch=16,
+                  seed=0).run(200)
+    assert r.violation is None and r.n_behaviors >= 200
+
+
+def test_symmetry_rejected():
+    with pytest.raises(ValueError, match="SYMMETRY"):
+        Simulator(CheckConfig(bounds=B3, spec="election", invariants=(),
+                              symmetry=("Server",)))
+
+
+def test_cli_simulate(tmp_path):
+    from test_cli import run_cli, write_cfg
+    from raft_tla_tpu import check as cli
+    cfg = write_cfg(tmp_path / "s.cfg")
+    code, out = run_cli(cfg, "--engine", "ref", "--spec", "election",
+                        "--max-term", "2", "--max-log", "0",
+                        "--max-msgs", "2", "--simulate", "300",
+                        "--depth", "25", "--walkers", "64", "--seed", "5")
+    assert code == cli.EXIT_OK
+    assert "behaviors generated" in out and "not exhaustive" in out
