@@ -104,3 +104,16 @@ def test_cli_simulate(tmp_path):
                         "--depth", "25", "--walkers", "64", "--seed", "5")
     assert code == cli.EXIT_OK
     assert "behaviors generated" in out and "not exhaustive" in out
+
+
+def test_simulation_composes_with_faithful_mode():
+    """build_expand carries the history fields, so random walks generate
+    and invariant-check faithful states unchanged."""
+    bh = Bounds(n_servers=2, n_values=1, max_term=2, max_log=1, max_msgs=2,
+                history=True, max_elections=4)
+    cc = CheckConfig(bounds=bh, spec="full",
+                     invariants=("NoTwoLeaders", "ElectionSafetyHist",
+                                 "AllLogsPrefixClosed"))
+    r = Simulator(cc, walkers=64, depth=30, steps_per_dispatch=16,
+                  seed=2).run(300)
+    assert r.violation is None and r.n_behaviors >= 300
