@@ -348,9 +348,23 @@ def main(argv=None) -> int:
         print(f"TLC parity artifacts: {tla}, {cfgp}")
 
     if args.simulate is not None:
+        if props:
+            # Liveness needs the full behavior graph; sampling cannot check
+            # it — reject rather than silently report OK.
+            print(f"Error: PROPERTY {list(props)} cannot be checked in "
+                  "--simulate mode (liveness needs exhaustive search)",
+                  file=sys.stderr)
+            return EXIT_ERROR
         if args.cpu:
             import jax
-            jax.config.update("jax_platforms", "cpu")
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except RuntimeError:
+                if jax.default_backend() != "cpu":
+                    print("Warning: --cpu requested but JAX backends are "
+                          "already initialized on "
+                          f"{jax.default_backend()!r}; proceeding there",
+                          file=sys.stderr)
         try:
             return _simulate(args, config)
         except Exception as e:

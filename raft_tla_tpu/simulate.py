@@ -71,20 +71,25 @@ def _build_sim_segment(config: CheckConfig, walkers: int, depth: int,
     BIG = jnp.int32(np.iinfo(np.int32).max)
 
     def one_step(carry, key, init_vec):
-        vecs, hist, hlen, n_beh, n_st, maxd, viol_w, viol_i, dead_w = carry
+        (vecs, hist, hlen, n_beh, n_st, maxd, viol_w, viol_i, dead_w,
+         fail) = carry
         structs = jax.vmap(lambda v: st.unpack(v, lay, jnp))(vecs)
-        succs, valid, _ovf = jax.vmap(expand)(structs)      # [B, A, ...]
-        svecs = jax.vmap(jax.vmap(lambda t: st.pack(t, jnp)))(succs)
+        succs, valid, ovf = jax.vmap(expand)(structs)       # [B, A, ...]
 
-        # sample one enabled lane per walker (uniform over enabled)
+        # sample one enabled lane per walker (uniform over enabled), then
+        # gather just that lane from the successor tree — packing all A
+        # lanes first would do A-fold wasted work in the hot loop.
         logits = jnp.where(valid, 0.0, -jnp.inf)
         lane = jax.random.categorical(key, logits, axis=-1).astype(I32)
         enabled = jnp.any(valid, axis=-1)                   # [B]
         lane = jnp.where(enabled, lane, 0)
-        pick = jnp.take_along_axis(
-            svecs, lane[:, None, None], axis=1)[:, 0]       # [B, W]
-        pick_s = jax.vmap(lambda v: st.unpack(v, lay, jnp))(pick)
+        rows = jnp.arange(walkers)
+        pick_s = jax.tree.map(lambda x: x[rows, lane], succs)
+        pick = jax.vmap(lambda t: st.pack(t, jnp))(pick_s)  # [B, W]
         con_ok = jax.vmap(lambda t: st.constraint_ok(t, bounds, jnp))(pick_s)
+        # capacity overflow on a taken lane is a soundness bug — loud, never
+        # clamped (SURVEY §4.5), like every engine.
+        fail = fail | jnp.any(enabled & ovf[rows, lane])
         if inv_fns:
             inv_ok = jnp.stack([jax.vmap(f)(pick_s) for f in inv_fns],
                                axis=-1)                     # [B, nI]
@@ -135,13 +140,15 @@ def _build_sim_segment(config: CheckConfig, walkers: int, depth: int,
         # freeze the violating walker's successor (for completeness we keep
         # the pre-violation vec; the trace replays from history anyway)
         stop = (viol_w >= 0) | (dead_w >= 0)
+        stop = stop | fail
         return (vecs2, hist, hlen3, n_beh, n_st, maxd, viol_w, viol_i,
-                dead_w), stop
+                dead_w, fail), stop
 
     def segment(key, init_vec, vecs, hist, hlen, n_beh, n_st, maxd):
         viol_w = jnp.int32(-1)
         viol_i = jnp.int32(0)
         dead_w = jnp.int32(-1)
+        fail = jnp.bool_(False)
         keys = jax.random.split(key, steps)
 
         def body(i, carry):
@@ -153,7 +160,7 @@ def _build_sim_segment(config: CheckConfig, walkers: int, depth: int,
                                 advance, None)
 
         carry = ((vecs, hist, hlen, n_beh, n_st, maxd, viol_w, viol_i,
-                  dead_w), jnp.bool_(False))
+                  dead_w, fail), jnp.bool_(False))
         stfin, _stop = jax.lax.fori_loop(0, steps, body, carry)
         return stfin
 
@@ -206,13 +213,21 @@ class Simulator:
         while True:
             key, sub = jax.random.split(key)
             (vecs, hist, hlen, n_beh, n_st, maxd, viol_w, viol_i,
-             dead_w) = self._segment(sub, iv, vecs, hist, hlen, n_beh,
-                                     n_st, maxd)
+             dead_w, fail) = self._segment(sub, iv, vecs, hist, hlen,
+                                           n_beh, n_st, maxd)
+            if bool(fail):
+                raise RuntimeError(
+                    "simulation aborted: a sampled transition overflowed "
+                    "the tensor encoding — bounds reasoning violated "
+                    "(config.py capacity scheme)")
             vw, dw = int(viol_w), int(dead_w)
             if vw >= 0 or dw >= 0:
+                # If both landed in the same dispatch (different walkers),
+                # report the invariant violation — its walker's history is
+                # the one we replay, so label and trace must agree.
                 w = vw if vw >= 0 else dw
-                name = DEADLOCK if dw >= 0 else \
-                    self.config.invariants[int(viol_i)]
+                name = self.config.invariants[int(viol_i)] if vw >= 0 \
+                    else DEADLOCK
                 trace = self._replay(init_py, np.asarray(hist[w]),
                                      int(hlen[w]))
                 return SimResult(

@@ -117,3 +117,13 @@ def test_simulation_composes_with_faithful_mode():
     r = Simulator(cc, walkers=64, depth=30, steps_per_dispatch=16,
                   seed=2).run(300)
     assert r.violation is None and r.n_behaviors >= 300
+
+
+def test_cli_simulate_rejects_properties(tmp_path):
+    from test_cli import run_cli, write_cfg
+    from raft_tla_tpu import check as cli
+    cfg = write_cfg(tmp_path / "p.cfg", extra="PROPERTY EventuallyLeader\n")
+    code, _ = run_cli(cfg, "--engine", "ref", "--spec", "election",
+                      "--max-term", "2", "--max-log", "0",
+                      "--max-msgs", "2", "--simulate", "10")
+    assert code == cli.EXIT_ERROR
