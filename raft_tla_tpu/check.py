@@ -165,14 +165,16 @@ def _resolve_config(args):
             f"unknown PROPERTY {bad_props}; registry: "
             f"{sorted(live_mod.PROPERTIES)}")
     sym_names = set(cfg.symmetry) | ({"Server"} if args.symmetry else set())
-    bad_sym = sym_names - {"Server", "SymServer", "Value", "SymValue"}
+    bad_sym = sym_names - {"Server", "SymServer", "Value", "SymValue",
+                           "SymServerValue"}
     if bad_sym:
         raise ValueError(
             f"SYMMETRY {sorted(bad_sym)} not supported: Server and/or "
             "Value permutation symmetry (name them Server/SymServer, "
-            "Value/SymValue)")
+            "Value/SymValue, or the combined SymServerValue)")
     symmetry = tuple(ax for ax in ("Server", "Value")
-                     if {ax, f"Sym{ax}"} & sym_names)
+                     if {ax, f"Sym{ax}"} & sym_names
+                     or "SymServerValue" in sym_names)
     # Our own --emit-tlc artifacts declare the constraint/view this checker
     # builds in; anything else would be silently unchecked.
     if [c for c in cfg.constraints if c != "StateConstraint"]:

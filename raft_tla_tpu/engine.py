@@ -189,8 +189,6 @@ class Engine:
                         "state-capacity overflow at "
                         f"{self.table[int(a)].label()} — bounds reasoning "
                         "violated (config.py capacity scheme)")
-                n_transitions += int(valid.sum())
-
                 # TLC's default deadlock check: an expanded state with no
                 # successor (stuttering excluded).  Successors of earlier
                 # rows in the chunk are recorded first — refbfs order.
@@ -207,6 +205,10 @@ class Engine:
                 if dead_limit is not None:
                     flat_valid = flat_valid.copy()
                     flat_valid[dead_limit:] = False
+                # Count transitions AFTER the dead-state truncation so the
+                # stats stay refbfs-exact on deadlock counterexamples (the
+                # oracle stops counting at the first dead state).
+                n_transitions += int(flat_valid.sum())
                 cand = np.nonzero(flat_valid)[0]
                 new_flat: list[int] = []
                 for fi in cand:

@@ -21,7 +21,8 @@ the read-only reference*:
   verbatim-equivalent, plus the INVARIANT/CONSTRAINT/VIEW stanzas.
 
 No JVM exists in this environment, so these artifacts are validated
-structurally (tests/test_tla_export.py) and by round-tripping through
+structurally (tests/test_cli.py::test_tla_export_structure) and by
+round-tripping through
 ``utils/cfgparse``; running them under stock TLC is the documented
 parity procedure for a host that has one (README).
 
@@ -42,6 +43,26 @@ from raft_tla_tpu.config import Bounds
 from raft_tla_tpu.utils.cfgparse import TLCConfig
 
 MODULE_NAME = "MCraft"
+
+
+def _sym_axes(symmetry) -> tuple:
+    """Normalize the ``symmetry`` argument (True or an axis iterable) to a
+    canonical ``("Server",)`` / ``("Value",)`` / ``("Server", "Value")``."""
+    raw = ("Server",) if symmetry is True else tuple(symmetry)
+    bad = [ax for ax in raw if ax not in ("Server", "Value")]
+    if bad:
+        raise ValueError(f"unknown symmetry axes {bad}: only Server/Value "
+                         "permutation symmetry exists in this checker")
+    return tuple(ax for ax in ("Server", "Value") if ax in raw)
+
+
+def _sym_name(symmetry) -> str:
+    """Axis-encoded SYMMETRY operator name (``SymServer`` /
+    ``SymValue`` / ``SymServerValue``) — one of the names
+    ``check.py:_resolve_config`` accepts, so the emitted cfg
+    round-trips through this checker as well as TLC.  Canonical
+    axis order regardless of the caller's tuple order."""
+    return "Sym" + "".join(_sym_axes(symmetry))
 
 # TLA+ text per registry invariant (names match models/invariants.REGISTRY).
 _INVARIANT_TLA = {
@@ -139,11 +160,13 @@ StateConstraint ==
     if parity_view:
         parts += [_PARITY_VIEW, ""]
     if symmetry:
-        axes = ("Server",) if symmetry is True else tuple(symmetry)
-        union = " \\cup ".join(f"Permutations({ax})" for ax in axes)
+        union = " \\cup ".join(f"Permutations({ax})"
+                               for ax in _sym_axes(symmetry))
+        # Axis-encoded name (SymServer / SymValue / SymServerValue) so
+        # check.py:_resolve_config accepts its own --emit-tlc artifact.
         parts += ["\\* TLC symmetry set matching the checker's "
                   "symmetry reduction.",
-                  f"SymSet == {union}", ""]
+                  f"{_sym_name(symmetry)} == {union}", ""]
     parts.append("=" * 77)
     return "\n".join(parts)
 
@@ -159,7 +182,7 @@ def emit_cfg(bounds: Bounds, invariants: tuple,
         *[f"INVARIANT {nm}" for nm in invariants],
         "CONSTRAINT StateConstraint",
         *(["VIEW ParityView"] if parity_view else []),
-        *(["SYMMETRY SymSet"] if symmetry else []),
+        *([f"SYMMETRY {_sym_name(symmetry)}"] if symmetry else []),
         "",
         "CONSTANTS",
         f"    Server = {{{servers}}}",

@@ -193,10 +193,14 @@ def _build_segment(config: CheckConfig, caps: PagedCapacities, A: int,
         # so the host can page out before the ring laps itself.
         nonlocal budget, pause
         budget, pause = budget_, pause_at
-        _, carry = jax.lax.while_loop(
+        steps, carry = jax.lax.while_loop(
             lambda sc: outer_cond(sc) & (sc[1].n_states < pause),
             lambda sc: outer_body(sc), (jnp.int32(0), carry))
-        return carry, _carry_done(carry)
+        # Executed chunk count: paged segments routinely end mid-budget
+        # (the pause_at pageout yield), so the host's per-chunk cost
+        # estimate must divide by THIS, not the requested budget —
+        # otherwise the watchdog clamp projects oversized segments.
+        return carry, _carry_done(carry), steps
 
     budget = pause = None
     return segment
@@ -371,8 +375,8 @@ class PagedEngine:
             # rows < pause_at are safe while n_states - lvl_start <= ring.
             pause_at = paged + self.caps.ring // 2
             t_seg = time.monotonic()
-            carry, done = self._segment(carry, jnp.int32(budget),
-                                        jnp.int32(pause_at))
+            carry, done, steps_d = self._segment(carry, jnp.int32(budget),
+                                                 jnp.int32(pause_at))
             n_states = int(carry.n_states)
             paged = self._pageout(carry, host, paged, n_states)
             if on_progress is not None:
@@ -380,13 +384,16 @@ class PagedEngine:
             if bool(done):
                 break
             dt = time.monotonic() - t_seg
+            # dt includes the pageout above — attributing it to chunk cost
+            # overestimates, which is the safe direction for the watchdog.
+            executed = max(1, int(steps_d))
             if checkpoint and (time.monotonic() - last_ckpt
                                >= checkpoint_every_s):
                 self.save_checkpoint(checkpoint, carry, host, paged,
                                      (hi0, lo0))
                 last_ckpt = time.monotonic()
             if not first and dt > 0.05:
-                worst_s_per_chunk = max(worst_s_per_chunk, dt / budget)
+                worst_s_per_chunk = max(worst_s_per_chunk, dt / executed)
                 scale = min(2.0, max(0.25, self.SEG_TARGET_S / dt))
                 budget = int(min(self.SEG_MAX,
                                  max(self.SEG_MIN, budget * scale)))

@@ -342,9 +342,12 @@ def _build_segment(config: CheckConfig, caps: ShardCapacities,
     def segment(carry: SCarry, budget_):
         nonlocal budget
         budget = budget_
-        _, carry = jax.lax.while_loop(outer_cond, outer_body,
-                                      (jnp.int32(0), carry))
-        return carry
+        steps, carry = jax.lax.while_loop(outer_cond, outer_body,
+                                          (jnp.int32(0), carry))
+        # Executed chunk count (lockstep-replicated) — the host divides the
+        # segment wall time by THIS, not the requested budget, so a segment
+        # cut short never underestimates per-chunk cost (advisor finding).
+        return steps, carry
 
     budget = None
     return segment
@@ -377,7 +380,8 @@ class ShardEngine:
         fn = _build_segment(config, self.caps, self.A, self.lay.width,
                             self.ndev)
         self._segment = jax.jit(jax.shard_map(
-            fn, mesh=self.mesh, in_specs=(specs, P()), out_specs=specs,
+            fn, mesh=self.mesh, in_specs=(specs, P()),
+            out_specs=(P(), specs),
             check_vma=False), donate_argnums=(0,))
         self._shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), specs)
@@ -476,20 +480,25 @@ class ShardEngine:
         last_ckpt = time.monotonic()
         while True:
             t_seg = time.monotonic()
-            carry = self._segment(carry, jnp.int32(budget))
+            steps_d, carry = self._segment(carry, jnp.int32(budget))
             if on_progress is not None:
                 on_progress(self._progress_stats(carry, t0))
             if bool(np.asarray(carry.stop)):
                 break
             dt = time.monotonic() - t_seg
+            executed = max(1, int(np.asarray(steps_d)))
             if checkpoint and (time.monotonic() - last_ckpt
                                >= checkpoint_every_s):
                 self.save_checkpoint(checkpoint, carry, (hi0, lo0))
                 last_ckpt = time.monotonic()
             if not first and dt > 0.05:
                 # Same watchdog clamp as DeviceEngine.check: never project a
-                # segment past SEG_CLAMP_S at the worst chunk cost seen.
-                worst_s_per_chunk = max(worst_s_per_chunk, dt / budget)
+                # segment past SEG_CLAMP_S at the worst chunk cost seen —
+                # per EXECUTED chunk, not the requested budget.  Today only
+                # final (stop) segments exit early and those break above;
+                # dividing by the executed count keeps the estimate exact if
+                # a future pause/yield path ends a segment mid-budget.
+                worst_s_per_chunk = max(worst_s_per_chunk, dt / executed)
                 scale = min(2.0, max(0.25, self.SEG_TARGET_S / dt))
                 budget = int(min(self.SEG_MAX,
                                  max(self.SEG_MIN, budget * scale)))
