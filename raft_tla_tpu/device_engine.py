@@ -198,6 +198,8 @@ FAIL_PROBE = 2      # linear probe exceeded _MAX_PROBE (table too full)
 FAIL_STORE = 4      # more distinct states than Capacities.n_states
 FAIL_LEVEL = 8      # BFS deeper than Capacities.levels
 FAIL_RING = 16      # paged engine: live BFS window outgrew the HBM ring
+# 32 is FAIL_ROUTE (shard engine, parallel/shard_engine.py)
+FAIL_INDEX = 64     # paged engine: discovery index near the int32 ceiling
 
 _FAIL_TEXT = {
     FAIL_WIDTH: "state-width overflow (encoding capacity exceeded)",
@@ -205,12 +207,57 @@ _FAIL_TEXT = {
     FAIL_STORE: "state-store capacity exceeded",
     FAIL_LEVEL: "BFS level capacity exceeded",
     FAIL_RING: "live BFS window exceeded the HBM ring",
+    FAIL_INDEX: "global state index reached the int32 ceiling "
+                "(2^31-1 rows/device is the per-run limit)",
 }
 
 
 def decode_fail(fail_bits: int) -> str:
     return "; ".join(txt for bit, txt in _FAIL_TEXT.items()
                      if fail_bits & bit) or "unknown"
+
+
+# -- 64-bit run counters without jax_enable_x64 ----------------------------
+# JAX's default x64-disabled mode silently narrows jnp.int64 to int32, and
+# the round-1 flagship already logged 258M transitions — a 5-server/2-value
+# run exceeds 2^31, where an int32 accumulator wraps silently.  Counters
+# that can pass 2^31 are therefore carried as TWO uint32 limbs with
+# branchless carry propagation (regression: tests/test_device_engine.py::
+# test_transition_counter_64bit).  State *indices* stay int32: the device
+# and shard engines bound rows by Capacities.n_states (far below 2^31 at
+# any allocatable HBM size; the shard engine additionally asserts
+# ndev * n_states fits the int32 global-id space at construction), and the
+# paged engine fails loudly via FAIL_INDEX before its global discovery
+# index could wrap.
+
+def _acc64_zero():
+    return jnp.zeros((2,), U32)
+
+
+def _acc64_add(acc, delta):
+    """``acc (+)= delta`` for a traced int32 ``0 <= delta < 2^31``."""
+    lo = acc[..., 0] + delta.astype(U32)
+    hi = acc[..., 1] + (lo < acc[..., 0]).astype(U32)
+    return jnp.stack([lo, hi], axis=-1)
+
+
+def acc64_int(arr) -> int:
+    """Host side: combine two-limb counters (summing any leading axes)."""
+    a = np.asarray(arr, dtype=np.uint64).reshape(-1, 2)
+    return int(((a[:, 1] << np.uint64(32)) | a[:, 0]).sum())
+
+
+def widen_legacy_n_trans(arrs: list, fields: tuple) -> list:
+    """Checkpoint migration: round-1 checkpoints carried ``n_trans`` as a
+    scalar (or per-device vector of) int32; widen to the two-limb uint32
+    layout so long runs resume across the upgrade."""
+    i = fields.index("n_trans")
+    a = np.asarray(arrs[i])
+    if a.dtype != np.uint32:
+        lo = a.astype(np.int64).reshape(-1).astype(np.uint32)
+        limbs = np.stack([lo, np.zeros_like(lo)], axis=-1)
+        arrs[i] = limbs[0] if a.ndim == 0 else limbs.reshape(-1)
+    return arrs
 
 
 class Carry(NamedTuple):
@@ -231,7 +278,7 @@ class Carry(NamedTuple):
     lvl_end: jax.Array
     viol_g: jax.Array     # first violating row, -1 if none
     viol_i: jax.Array     # index into config.invariants
-    n_trans: jax.Array    # enabled (state, action) pairs seen
+    n_trans: jax.Array    # [2] uint32 limbs: enabled (state, action) pairs
     cov: jax.Array        # [A] per-lane new-state counts
     fail: jax.Array       # FAIL_* bitmask
     levels: jax.Array     # [Lcap] per-level new-state counts
@@ -271,7 +318,7 @@ def _build_segment(config: CheckConfig, caps: Capacities, A: int, W: int):
         out = step(vecs)
         con_par = jax.lax.dynamic_slice(conflag, (gstart,), (B,))
         valid = out["valid"] & row_act[:, None] & con_par[:, None]
-        n_trans = n_trans + jnp.sum(valid.astype(I32))
+        n_trans = _acc64_add(n_trans, jnp.sum(valid.astype(I32)))
         fail = fail | jnp.any(valid & out["overflow"]) * FAIL_WIDTH
 
         fhi = out["fp_hi"].reshape(-1)
@@ -393,7 +440,7 @@ def _build_init(caps: Capacities, A: int, W: int):
         levels = jnp.zeros((Lcap,), I32)
         return Carry(store, parent, lane, conflag, tbl_hi, tbl_lo,
                      jnp.int32(1), jnp.int32(0), jnp.int32(1),
-                     jnp.int32(-1), jnp.int32(0), jnp.int32(0),
+                     jnp.int32(-1), jnp.int32(0), _acc64_zero(),
                      jnp.zeros((A,), I32), jnp.int32(0),
                      levels, jnp.int32(1), jnp.int32(0))
 
@@ -405,7 +452,7 @@ def _progress_stats(carry: Carry, t0: float) -> dict:
     n_states, lvl, n_trans = jax.device_get(
         (carry.n_states, carry.lvl, carry.n_trans))
     wall = time.monotonic() - t0
-    n_states, n_trans = int(n_states), int(n_trans)
+    n_states, n_trans = int(n_states), acc64_int(n_trans)
     return {
         "wall_s": round(wall, 3),
         "n_states": n_states,
@@ -475,6 +522,7 @@ class DeviceEngine:
                 path, ckpt.config_digest(self.config, self.caps,
                                          init_key)) as z:
             arrs = [z[f"c{i}"] for i in range(len(Carry._fields))]
+        arrs = widen_legacy_n_trans(arrs, Carry._fields)
         carry = Carry(*(jnp.asarray(a) for a in arrs))
         if self.device is not None:
             carry = jax.device_put(carry, self.device)
@@ -561,7 +609,7 @@ class DeviceEngine:
                 f"(caps={self.caps}) — grow Capacities and rerun")
         out = {"store": carry.store, "parent": carry.parent,
                "lane": carry.lane, "viol_i": viol_i,
-               "n_transitions": n_trans}
+               "n_transitions": acc64_int(n_trans)}
         # The partially-explored violating level is never recorded (the
         # level window only advances on completed levels), matching refbfs.
         levels_arr = [1] + [int(x) for x in levels_dev[:int(n_levels)]

@@ -40,8 +40,9 @@ import numpy as np
 
 from raft_tla_tpu.config import CheckConfig
 from raft_tla_tpu.device_engine import (
-    _EMPTY, _dedup_insert, _progress_stats, BUCKET, Carry, FAIL_LEVEL,
-    FAIL_PROBE, FAIL_RING, FAIL_WIDTH, decode_fail, _carry_done)
+    _EMPTY, _dedup_insert, _progress_stats, BUCKET, Carry, FAIL_INDEX,
+    FAIL_LEVEL, FAIL_PROBE, FAIL_RING, FAIL_WIDTH, decode_fail, _carry_done,
+    _acc64_add, _acc64_zero, acc64_int, widen_legacy_n_trans)
 from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import bitpack
@@ -87,6 +88,7 @@ def _build_segment(config: CheckConfig, caps: PagedCapacities, A: int,
     Rcap, Lcap = caps.ring, caps.levels
     rmask = Rcap - 1
     BIG = jnp.int32(np.iinfo(np.int32).max)
+    IDX_CEIL = jnp.int32(np.iinfo(np.int32).max - 2 * B * A)
 
     def chunk_body(carry: Carry) -> Carry:
         (store, parent, lane, conflag, tbl_hi, tbl_lo, n_states,
@@ -99,7 +101,7 @@ def _build_segment(config: CheckConfig, caps: PagedCapacities, A: int,
         vecs = schema.unpack(store[ridx], jnp)
         out = step(vecs)
         valid = out["valid"] & row_act[:, None] & conflag[ridx][:, None]
-        n_trans = n_trans + jnp.sum(valid.astype(I32))
+        n_trans = _acc64_add(n_trans, jnp.sum(valid.astype(I32)))
         fail = fail | jnp.any(valid & out["overflow"]) * FAIL_WIDTH
 
         fhi = out["fp_hi"].reshape(-1)
@@ -115,6 +117,10 @@ def _build_segment(config: CheckConfig, caps: PagedCapacities, A: int,
         # Live window must fit the ring: appending past lvl_start + Rcap
         # would overwrite the frontier still being expanded.
         fail = fail | (n_states + n_new - lvl_start > Rcap) * FAIL_RING
+        # The paged engine is host-RAM-bounded, so (unlike the HBM-bounded
+        # engines) its int32 discovery index could genuinely reach 2^31 —
+        # fail loudly with a chunk's worth of headroom left.
+        fail = fail | (n_states > IDX_CEIL) * FAIL_INDEX
         ok = is_new & (pos - lvl_start < Rcap)
         sl = jnp.where(ok, pos & rmask, Rcap)
         svecs = schema.pack(out["svecs"].reshape(B * A, W), jnp)
@@ -223,7 +229,7 @@ def _build_init(caps: PagedCapacities, A: int, P: int):
         levels = jnp.zeros((Lcap,), I32)
         return Carry(store, parent, lane, conflag, tbl_hi, tbl_lo,
                      jnp.int32(1), jnp.int32(0), jnp.int32(1),
-                     jnp.int32(-1), jnp.int32(0), jnp.int32(0),
+                     jnp.int32(-1), jnp.int32(0), _acc64_zero(),
                      jnp.zeros((A,), I32), jnp.int32(0),
                      levels, jnp.int32(1), jnp.int32(0))
 
@@ -321,8 +327,9 @@ class PagedEngine:
         with ckpt.load_npz_checked(
                 path, ckpt.config_digest(self.config, self.caps,
                                          init_key)) as z:
-            carry = Carry(*(jnp.asarray(z[f"c{i}"])
-                            for i in range(len(Carry._fields))))
+            arrs = [z[f"c{i}"] for i in range(len(Carry._fields))]
+            carry = Carry(*(jnp.asarray(a) for a in
+                            widen_legacy_n_trans(arrs, Carry._fields)))
             paged = int(z["paged"])
         host = native.make_store(self.schema.P)
         ckpt.stream_rows_in(path + ".rows", host.append, paged,
@@ -437,7 +444,7 @@ class PagedEngine:
 
         return EngineResult(
             n_states=n_states, diameter=len(levels_arr) - 1,
-            n_transitions=int(n_trans), coverage=coverage,
+            n_transitions=acc64_int(n_trans), coverage=coverage,
             violation=violation, levels=levels_arr,
             wall_s=time.monotonic() - t0)
 

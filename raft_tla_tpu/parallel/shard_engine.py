@@ -70,7 +70,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tla_tpu.config import CheckConfig
 from raft_tla_tpu.device_engine import (
     _EMPTY, _dedup_insert, BUCKET, FAIL_LEVEL, FAIL_PROBE, FAIL_STORE,
-    FAIL_WIDTH, decode_fail)
+    FAIL_WIDTH, decode_fail, _acc64_add, acc64_int, widen_legacy_n_trans)
 from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import fingerprint as fpr
@@ -137,7 +137,7 @@ class SCarry(NamedTuple):
     lvl_end: jax.Array    # [dev] [1]
     viol_g: jax.Array     # [dev] [1] first violating GLOBAL id, -1 if none
     viol_i: jax.Array     # [dev] [1] invariant index (n_inv = deadlock)
-    n_trans: jax.Array    # [dev] [1]
+    n_trans: jax.Array    # [dev] [2] uint32 limbs (64-bit counter)
     cov: jax.Array        # [dev] [A]
     fail: jax.Array       # [dev] [1] FAIL_* bitmask
     levels: jax.Array     # replicated [Lcap] global per-level new states
@@ -181,7 +181,7 @@ def _build_segment(config: CheckConfig, caps: ShardCapacities,
         viol_g, viol_i = carry.viol_g[0], carry.viol_i[0]
         store, parent, lane = carry.store, carry.parent, carry.lane
         conflag, tbl_hi, tbl_lo = carry.conflag, carry.tbl_hi, carry.tbl_lo
-        n_trans, cov = carry.n_trans[0], carry.cov
+        n_trans, cov = carry.n_trans, carry.cov
 
         # ---- expand my chunk (rows may be inactive on ragged levels) ----
         start = lvl_start + carry.c * B
@@ -192,7 +192,7 @@ def _build_segment(config: CheckConfig, caps: ShardCapacities,
         out = step(vecs)
         con_par = jax.lax.dynamic_slice(conflag, (gstart,), (B,))
         valid = out["valid"] & row_act[:, None] & con_par[:, None]
-        n_trans = n_trans + jnp.sum(valid.astype(I32))
+        n_trans = _acc64_add(n_trans, jnp.sum(valid.astype(I32)))
         fail = fail | jnp.any(valid & out["overflow"]) * FAIL_WIDTH
 
         # ---- route candidates to their fingerprint owners ----
@@ -294,7 +294,7 @@ def _build_segment(config: CheckConfig, caps: ShardCapacities,
         return carry._replace(
             store=store, parent=parent, lane=lane, conflag=conflag,
             tbl_hi=tbl_hi, tbl_lo=tbl_lo,
-            n_states=n_states[None], n_trans=n_trans[None], cov=cov,
+            n_states=n_states[None], n_trans=n_trans, cov=cov,
             viol_g=viol_g[None], viol_i=viol_i[None], fail=fail[None],
             stop=stop, c=carry.c + 1)
 
@@ -375,6 +375,15 @@ class ShardEngine:
         self.caps = caps or ShardCapacities()
         if self.caps.n_states < config.chunk:
             raise ValueError("ShardCapacities.n_states must be >= chunk")
+        # Global state ids are int32 ``dev * Ncap + row`` (parent links,
+        # viol_g): the address space must fit, or ids on high-numbered
+        # devices wrap negative — corrupt traces and a silently missed
+        # violation stop.  Fail at construction, not mid-run.
+        if self.ndev * self.caps.n_states > 2**31 - 1:
+            raise ValueError(
+                f"ndev * n_states = {self.ndev} * {self.caps.n_states} "
+                "exceeds the int32 global-id space (2^31-1); shrink "
+                "ShardCapacities.n_states")
         self.seg_chunks = seg_chunks
         specs = _carry_specs()
         fn = _build_segment(config, self.caps, self.A, self.lay.width,
@@ -414,7 +423,7 @@ class ShardEngine:
             lvl_end=n0.copy(),
             viol_g=np.full((nd,), -1, np.int32),
             viol_i=np.zeros((nd,), np.int32),
-            n_trans=np.zeros((nd,), np.int32),
+            n_trans=np.zeros((nd * 2,), np.uint32),
             cov=np.zeros((nd * A,), np.int32),
             fail=np.zeros((nd,), np.int32),
             levels=np.zeros((Lcap,), np.int32),
@@ -444,7 +453,8 @@ class ShardEngine:
                     self.config, self.caps,
                     init_key + (self.ndev,))) as z:
             arrs = [z[f"c{i}"] for i in range(len(SCarry._fields))]
-        return self._put(SCarry(*arrs))
+        return self._put(SCarry(*widen_legacy_n_trans(
+            arrs, SCarry._fields)))
 
     # -- public API ----------------------------------------------------------
 
@@ -544,7 +554,7 @@ class ShardEngine:
         return EngineResult(
             n_states=n_states,
             diameter=len(levels_arr) - 1,
-            n_transitions=int(np.asarray(n_trans_d).sum()),
+            n_transitions=acc64_int(n_trans_d),
             coverage=coverage,
             violation=violation,
             levels=levels_arr,
@@ -554,7 +564,7 @@ class ShardEngine:
         n_states_d, lvl, n_trans_d = jax.device_get(
             (carry.n_states, carry.lvl, carry.n_trans))
         n_states = int(np.asarray(n_states_d).sum())
-        n_trans = int(np.asarray(n_trans_d).sum())
+        n_trans = acc64_int(n_trans_d)
         wall = time.monotonic() - t0
         return {
             "wall_s": round(wall, 3),

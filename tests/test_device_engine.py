@@ -125,3 +125,62 @@ def test_store_overflow_is_loud():
                       spec="election", invariants=(), chunk=64)
     with pytest.raises(RuntimeError, match="capacity"):
         DeviceEngine(cfg, Capacities(n_states=256, levels=64)).check()
+
+
+def test_transition_counter_64bit():
+    """Run counters must survive past 2^31 (VERDICT r1 weak #3): JAX's
+    default x64-disabled mode narrows int64 silently, so the engines carry
+    two uint32 limbs with explicit carry propagation."""
+    import jax.numpy as jnp
+    import numpy as np
+    from raft_tla_tpu.device_engine import (
+        _acc64_add, _acc64_zero, acc64_int, widen_legacy_n_trans, Carry)
+
+    z = _acc64_zero()
+    assert z.dtype == jnp.uint32 and z.shape == (2,)
+    # limb carry across the 2^32 boundary
+    acc = jnp.asarray(np.array([0xFFFFFFFF, 0], np.uint32))
+    acc = _acc64_add(acc, jnp.int32(1))
+    assert acc64_int(acc) == 1 << 32
+    acc = _acc64_add(acc, jnp.int32(2**31 - 1))
+    assert acc64_int(acc) == (1 << 32) + 2**31 - 1
+    # legacy checkpoint migration: scalar int32 (device/paged carries)
+    i = Carry._fields.index("n_trans")
+    arrs = [None] * len(Carry._fields)
+    arrs[i] = np.int32(123)
+    out = widen_legacy_n_trans(list(arrs), Carry._fields)
+    assert out[i].dtype == np.uint32 and out[i].shape == (2,)
+    assert acc64_int(out[i]) == 123
+    # legacy per-device vector (shard carries): [v_d] -> flat [v_d, 0] limbs
+    arrs[i] = np.array([5, 7], np.int32)
+    out = widen_legacy_n_trans(list(arrs), Carry._fields)
+    assert out[i].shape == (4,) and acc64_int(out[i]) == 12
+    # already-widened checkpoints pass through untouched
+    out2 = widen_legacy_n_trans(list(out), Carry._fields)
+    assert out2[i] is out[i]
+
+
+def test_engine_carry_uses_limb_counter(tmp_path):
+    """The saved checkpoint (= the live carry) must hold the two-limb
+    uint32 transition counter, not an int32 scalar."""
+    import numpy as np
+    from raft_tla_tpu.device_engine import Carry
+    from raft_tla_tpu.models import interp
+    from raft_tla_tpu.ops import symmetry as sym_mod
+
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",), chunk=64)
+    eng = DeviceEngine(cfg, CAPS)
+    init_py = interp.init_state(cfg.bounds)
+    init_vec = interp.to_vec(init_py, cfg.bounds)
+    hi0, lo0 = sym_mod.init_fingerprint(cfg, init_py, init_vec)
+    import jax.numpy as jnp
+    carry = eng._init(jnp.asarray(np.asarray(init_vec, np.int32)),
+                      jnp.uint32(hi0), jnp.uint32(lo0), jnp.bool_(True))
+    p = str(tmp_path / "c.npz")
+    eng.save_checkpoint(p, carry, (hi0, lo0))
+    i = Carry._fields.index("n_trans")
+    with np.load(p) as z:
+        a = z[f"c{i}"]
+    assert a.dtype == np.uint32 and a.shape == (2,)
