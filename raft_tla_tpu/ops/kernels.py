@@ -529,16 +529,23 @@ def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
     consts = jnp.asarray(fpr.lane_constants(lay.width))
     expand = build_expand(bounds, spec)
     inv_fns = [inv_mod.jnp_invariant(nm, bounds) for nm in invariants]
+    # Scan-compiled orbit pass: ONE copy of the permute/canonicalize/pack/
+    # fingerprint pipeline iterated over the n!*V! group, not n!*V!
+    # unrolled copies (ops/symmetry.build_orbit_fp) — bit-identical keys.
+    orbit_fp = sym.build_orbit_fp(bounds, symmetry, consts,
+                                  "allLogs" in lay.shapes) \
+        if symmetry else None
 
     def step(vecs):
         structs = jax.vmap(lambda v: st.unpack(v, lay, jnp))(vecs)
         succs, valid, ovf = jax.vmap(expand)(structs)
         svecs = jax.vmap(jax.vmap(lambda t: st.pack(t, jnp)))(succs)
         if symmetry:
-            fp_hi, fp_lo = jax.vmap(jax.vmap(
-                lambda t: sym.orbit_fingerprint(t, bounds, consts, jnp,
-                                symmetry))
-            )(succs)
+            flat = jax.tree.map(
+                lambda a: a.reshape((-1,) + a.shape[2:]), succs)
+            fh, fl = orbit_fp(flat)
+            fp_hi = fh.reshape(svecs.shape[:2])
+            fp_lo = fl.reshape(svecs.shape[:2])
         else:
             fp_hi, fp_lo = fpr.fingerprint(svecs, consts, jnp)
         if inv_fns:

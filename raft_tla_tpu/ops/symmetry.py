@@ -209,6 +209,196 @@ def permute_struct(struct: dict, p: tuple, bounds: Bounds, xp) -> dict:
     return out
 
 
+def _server_luts(bounds: Bounds) -> tuple:
+    """Stacked lookup tables for every server permutation — the data that
+    lets ONE compiled transform apply any group element (build_orbit_fp):
+    ``inv_idx [P, n]`` row gathers, ``vf_map [P, n+1]`` votedFor relabel,
+    ``bit_lut [P, 2^n]`` vote-bitmask permutation, ``p_lut [P, 16]``
+    message src/dst relabel (4-bit fields)."""
+    ps = permutations(bounds)
+    n = bounds.n_servers
+    P = len(ps)
+    inv_idx = np.empty((P, n), np.int32)
+    vf_map = np.empty((P, n + 1), np.int32)
+    bit_lut = np.empty((P, 1 << n), np.int32)
+    p_lut = np.zeros((P, 16), np.int32)
+    masks = np.arange(1 << n, dtype=np.int64)
+    for i, p in enumerate(ps):
+        inv_idx[i] = [p.index(k) for k in range(n)]
+        vf_map[i] = (0,) + tuple(p[j] + 1 for j in range(n))
+        bm = np.zeros((1 << n,), np.int64)
+        for j in range(n):
+            bm |= ((masks >> j) & 1) << p[j]
+        bit_lut[i] = bm
+        p_lut[i, :n] = p
+    return inv_idx, vf_map, bit_lut, p_lut
+
+
+def _value_luts(bounds: Bounds, faithful: bool) -> dict:
+    """Stacked lookup tables per value permutation (build_orbit_fp):
+    ``vlut [Q, V+1]`` logVal relabel, ``e_lut [Q, 2^e_w]`` message
+    entry-value field, and in faithful mode the log-rank maps."""
+    qs = value_permutations(bounds)
+    V = bounds.n_values
+    e_sh, e_w = mb._LO_FIELDS["e"]
+    vlut = np.zeros((len(qs), V + 1), np.int32)
+    e_lut = np.zeros((len(qs), 1 << e_w), np.int32)
+    for i, q in enumerate(qs):
+        vlut[i] = (0,) + tuple(q[v - 1] + 1 for v in range(1, V + 1))
+        e_lut[i, :V + 1] = vlut[i]
+    out = {"vlut": vlut, "e_lut": e_lut}
+    if faithful:
+        rmaps = np.stack(_rank_maps(bounds))             # [Q, U]
+        U = rmaps.shape[1]
+        g_sh, g_w = mb._LO_FIELDS["g"]
+        out["rmap"] = rmaps
+        out["rlut1"] = np.concatenate(
+            [np.zeros((len(qs), 1), np.int32), rmaps + 1], axis=1)
+        out["g_lut"] = np.concatenate(
+            [rmaps, np.zeros((len(qs), (1 << g_w) - U), np.int32)], axis=1)
+    return out
+
+
+def _permute_struct_batch(struct: dict, inv, vf_map, bit_lut, p_lut, xp):
+    """``permute_struct`` over a leading batch axis, with the permutation
+    given as traced LUT rows (same arithmetic, same bits — the gathers
+    read precomputed tables instead of Python-side tuples)."""
+    def rows(a):
+        return xp.take(a, inv, axis=1)
+
+    s_sh, s_w = mb._HI_FIELDS["src"]
+    d_sh, d_w = mb._HI_FIELDS["dst"]
+    keep = ~(((1 << s_w) - 1) << s_sh | ((1 << d_w) - 1) << d_sh)
+    hi = struct["msgHi"]
+    occupied = struct["msgCount"] > 0
+    new_hi = (hi & keep) \
+        | (p_lut[(hi >> s_sh) & ((1 << s_w) - 1)] << s_sh) \
+        | (p_lut[(hi >> d_sh) & ((1 << d_w) - 1)] << d_sh)
+    new_hi = xp.where(occupied, new_hi, hi)
+
+    out = {
+        "role": rows(struct["role"]),
+        "term": rows(struct["term"]),
+        "votedFor": vf_map[rows(struct["votedFor"])],
+        "commitIndex": rows(struct["commitIndex"]),
+        "logLen": rows(struct["logLen"]),
+        "logTerm": rows(struct["logTerm"]),
+        "logVal": rows(struct["logVal"]),
+        "vResp": bit_lut[rows(struct["vResp"])],
+        "vGrant": bit_lut[rows(struct["vGrant"])],
+        "nextIndex": xp.take(rows(struct["nextIndex"]), inv, axis=2),
+        "matchIndex": xp.take(rows(struct["matchIndex"]), inv, axis=2),
+        "msgHi": new_hi,
+        "msgLo": struct["msgLo"],
+        "msgCount": struct["msgCount"],
+    }
+    if "eTerm" in struct:
+        eocc = struct["eTerm"] > 0
+        out.update({
+            "allLogs": struct["allLogs"],
+            "vLog": xp.take(rows(struct["vLog"]), inv, axis=2),
+            "eTerm": struct["eTerm"],
+            "eLeader": xp.where(eocc, p_lut[struct["eLeader"]],
+                                struct["eLeader"]),
+            "eLog": struct["eLog"],
+            "eVotes": xp.where(eocc, bit_lut[struct["eVotes"]],
+                               struct["eVotes"]),
+            "eVLog": xp.take(struct["eVLog"], inv, axis=2),
+        })
+    return out
+
+
+def _permute_values_batch(struct: dict, luts: dict, qi, bounds: Bounds, xp):
+    """``permute_values`` over a leading batch axis with traced LUT rows."""
+    vlut = luts["vlut"][qi]
+    e_lut = luts["e_lut"][qi]
+    e_sh, e_w = mb._LO_FIELDS["e"]
+    lo = struct["msgLo"]
+    out = dict(struct)
+    out["logVal"] = vlut[struct["logVal"]]
+    new_lo = (lo & ~(((1 << e_w) - 1) << e_sh)) \
+        | (e_lut[(lo >> e_sh) & ((1 << e_w) - 1)] << e_sh)
+    if "allLogs" in struct:
+        rmap = luts["rmap"][qi]
+        rlut1 = luts["rlut1"][qi]
+        g_lut = luts["g_lut"][qi]
+        U = int(rmap.shape[0])
+        out["vLog"] = rlut1[struct["vLog"]]
+        out["eLog"] = rmap[struct["eLog"]]
+        out["eVLog"] = rlut1[struct["eVLog"]]
+        g_sh, g_w = mb._LO_FIELDS["g"]
+        new_lo = (new_lo & ~(((1 << g_w) - 1) << g_sh)) \
+            | (g_lut[(new_lo >> g_sh) & ((1 << g_w) - 1)] << g_sh)
+        # allLogs bit-permute, batched (same sum-as-OR trick as
+        # permute_values; sign bit handled separately — no x64 under jit)
+        rs = np.arange(U)
+        Wa = struct["allLogs"].shape[1]
+        bits = (struct["allLogs"][:, rs // 32] >> (rs % 32)) & 1   # [N, U]
+        in_word = (rmap[None, :] // 32) == xp.arange(Wa)[:, None]  # [Wa, U]
+        tb = rmap % 32                                             # [U]
+        low = xp.where(
+            in_word[None] & (tb < 31)[None, None] & (bits[:, None, :] > 0),
+            xp.asarray(1, xp.int32) << tb, 0).sum(axis=2)
+        top = (in_word[None] & (tb == 31)[None, None]
+               & (bits[:, None, :] > 0)).any(axis=2)
+        out["allLogs"] = (low.astype(xp.int32)
+                          | xp.where(top, xp.asarray(-2**31, xp.int32), 0))
+    occupied = struct["msgCount"] > 0
+    out["msgLo"] = xp.where(occupied, new_lo, struct["msgLo"])
+    return out
+
+
+def build_orbit_fp(bounds: Bounds, axes: tuple, consts, faithful: bool):
+    """Batched orbit-minimal fingerprints: ``struct[N, ...] -> (hi, lo)[N]``.
+
+    Bit-identical to :func:`orbit_fingerprint` (same permute/canonicalize/
+    pack/fingerprint arithmetic; the (hi, lo) lexicographic min is
+    order-independent) but compiled as ONE transform iterated by
+    ``lax.scan`` over the |G| = n!·V! group elements, instead of |G|
+    unrolled copies of the pipeline.  The round-1 unrolled graph at five
+    servers (120 copies) crashed compiles at chunk 2048 and capped the
+    elect5 run at ~3k orbits/s; the scan keeps the program size constant
+    in |G| so large chunks compile and the VPU sees one tight loop.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    sluts = tuple(jnp.asarray(a) for a in _server_luts(bounds)) \
+        if "Server" in axes else None
+    vluts = {k: jnp.asarray(v)
+             for k, v in _value_luts(bounds, faithful).items()} \
+        if "Value" in axes else None
+    P = len(permutations(bounds)) if "Server" in axes else 1
+    Q = len(value_permutations(bounds)) if "Value" in axes else 1
+
+    def orbit_fp(struct):
+        N = struct["role"].shape[0]
+
+        def body(best, k):
+            pi, qi = k // Q, k % Q
+            t = struct
+            if sluts is not None:
+                inv_idx, vf_map, bit_lut, p_lut = sluts
+                t = _permute_struct_batch(t, inv_idx[pi], vf_map[pi],
+                                          bit_lut[pi], p_lut[pi], jnp)
+            if vluts is not None:
+                t = _permute_values_batch(t, vluts, qi, bounds, jnp)
+            packed = jax.vmap(
+                lambda s: st.pack(st.canonicalize(s, jnp), jnp))(t)
+            hi, lo = fpr.fingerprint(packed, consts, jnp)
+            bh, bl = best
+            take = (hi < bh) | ((hi == bh) & (lo < bl))
+            return (jnp.where(take, hi, bh), jnp.where(take, lo, bl)), None
+
+        init = (jnp.full((N,), 0xFFFFFFFF, jnp.uint32),
+                jnp.full((N,), 0xFFFFFFFF, jnp.uint32))
+        (bh, bl), _ = jax.lax.scan(body, init,
+                                   jnp.arange(P * Q, dtype=jnp.int32))
+        return bh, bl
+
+    return orbit_fp
+
+
 def orbit_fingerprint(struct: dict, bounds: Bounds, consts, xp,
                       axes: tuple = ("Server",)):
     """Orbit-minimal (hi, lo) fingerprint of one canonical state struct,

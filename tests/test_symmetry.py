@@ -197,3 +197,48 @@ def test_value_symmetry_faithful_mode():
     assert (ref.n_states, ref.diameter) == (28121, 32)  # of 84572 states
     assert (got.n_states, got.diameter) == (28121, 32)
     assert ref.violation is None and got.violation is None
+
+
+def test_scan_orbit_fp_bit_identical_to_loop():
+    """The scan-compiled orbit pass (build_orbit_fp — ONE transform
+    iterated over the group) must produce bit-identical (hi, lo) keys to
+    the reference unrolled loop (orbit_fingerprint): checkpointed runs
+    resume across the upgrade only if the keys are unchanged."""
+    import jax
+    import jax.numpy as jnp
+    from raft_tla_tpu.ops import fingerprint as fpr
+    from raft_tla_tpu.ops import state as st
+
+    def drive(bounds, axes, spec="full", depth=4):
+        lay = st.Layout.of(bounds)
+        consts = fpr.lane_constants(lay.width)
+        # a bag of reachable states: BFS prefix via the interpreter
+        frontier = [interp.init_state(bounds)]
+        seen = list(frontier)
+        for _ in range(depth):
+            nxt = []
+            for s in frontier:
+                nxt += [t for _i, t in interp.successors(s, bounds,
+                                                         spec=spec)]
+            frontier = nxt[:40]
+            seen += frontier
+        vecs = np.stack([interp.to_vec(s, bounds) for s in seen])
+        structs = jax.vmap(lambda v: st.unpack(v, lay, jnp))(
+            jnp.asarray(vecs))
+        fn = sym.build_orbit_fp(bounds, axes, jnp.asarray(consts),
+                                "allLogs" in lay.shapes)
+        hi_s, lo_s = jax.jit(fn)(structs)
+        for k, s in enumerate(seen):
+            struct = st.unpack(vecs[k], lay, np)
+            hi_l, lo_l = sym.orbit_fingerprint(struct, bounds, consts,
+                                               np, axes)
+            assert (int(hi_s[k]), int(lo_s[k])) == (int(hi_l), int(lo_l)), \
+                (axes, k, s)
+
+    b = Bounds(n_servers=3, n_values=2, max_term=2, max_log=1, max_msgs=2)
+    drive(b, ("Server",))
+    drive(b, ("Value",))
+    drive(b, ("Server", "Value"))
+    bh = Bounds(n_servers=2, n_values=2, max_term=2, max_log=1, max_msgs=2,
+                history=True, max_elections=4)
+    drive(bh, ("Server", "Value"))
