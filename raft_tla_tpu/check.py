@@ -411,10 +411,23 @@ def _check_liveness(args, config, props) -> int:
     from raft_tla_tpu.utils.render import render_state
 
     wf = () if args.wf.strip().lower() == "none" else         tuple(f.strip() for f in args.wf.split(",") if f.strip())
+    # Build the behavior graph once for all properties — on the device
+    # engine when one is selected (models/liveness.engine_graph reaches
+    # universes far past the interpreter), else with the interpreter.
+    try:
+        if args.engine not in ("host", "ref") and not config.symmetry:
+            from raft_tla_tpu.device_engine import Capacities
+            graph = liveness.engine_graph(config, Capacities(
+                n_states=args.cap, levels=args.levels))
+        else:
+            graph = liveness.explore_graph(config)
+    except (ValueError, RuntimeError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return EXIT_ERROR
     for nm in props:
         t0 = time.monotonic()
         try:
-            res = liveness.check(config, nm, wf=wf)
+            res = liveness.check(config, nm, wf=wf, graph=graph)
         except ValueError as e:
             print(f"Error: {e}", file=sys.stderr)
             return EXIT_ERROR

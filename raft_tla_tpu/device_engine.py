@@ -532,11 +532,17 @@ class DeviceEngine:
               checkpoint: str | None = None,
               checkpoint_every_s: float = 600.0,
               resume: str | None = None,
-              on_progress=None) -> EngineResult:
+              on_progress=None, retain_carry: bool = False) -> EngineResult:
         """``on_progress``, if given, is called after every segment with a
         dict of structured run stats (SURVEY §5 observability): wall
         seconds, states found, BFS level, transitions, dedup hit rate,
-        throughput.  Costs one extra scalar transfer per segment."""
+        throughput.  Costs one extra scalar transfer per segment.
+
+        ``retain_carry=True`` keeps the final carry on ``self.retained_carry``
+        (store/conflag for post-hoc passes, e.g. liveness graph export —
+        models/liveness.engine_graph).  The retained buffers stay resident
+        in HBM until the caller sets ``retained_carry = None``; a second
+        ``check`` on the same engine allocates a fresh carry alongside."""
         t0 = time.monotonic()
         bounds = self.bounds
         init_py = init_override if init_override is not None \
@@ -596,6 +602,8 @@ class DeviceEngine:
                     budget, int(self.SEG_CLAMP_S / worst_s_per_chunk)))
                 self.seg_chunks = budget    # warm check() calls start tuned
             first = False
+        if retain_carry:
+            self.retained_carry = carry
         # One batched transfer for all the small outputs; the wide arrays
         # (store, parent, lane) stay on device unless a trace is needed.
         (n_states, viol_g, viol_i, n_trans, fail, n_levels, levels_dev,

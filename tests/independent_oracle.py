@@ -1,0 +1,376 @@
+"""A third, independently-derived interpreter of the reference spec.
+
+Purpose (VERDICT r1 "what's weak" #8): every parity chain in this repo
+bottoms out at ``raft_tla_tpu/models/interp.py`` — a shared misreading of
+``raft.tla`` would pass every differential test.  This module is a second,
+*separate* transcription of ``/root/reference/raft.tla`` written directly
+from the spec text with a deliberately different representation (records
+and frozensets rather than packed arrays; the message bag as a frozenset
+of ``(record, count)`` pairs), used by ``tests/test_independent_oracle.py``
+to cross-check BFS level counts and full-space sizes against the package's
+oracle and engines.  It intentionally lives under ``tests/`` — it is a
+test instrument, not a product code path, and nothing in the package may
+import it.
+
+Parity mode only: the history variables (``elections``/``allLogs``/
+``voterLog``, raft.tla:39,44,77) and history-only message fields (``mlog``,
+raft.tla:220-222,297-299) are omitted — the same state identity the
+package's parity mode uses (SURVEY §7.0.3).
+
+Every function cites the raft.tla lines it transcribes.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import NamedTuple
+
+FOLLOWER, CANDIDATE, LEADER = "F", "C", "L"
+NIL = None
+
+
+class RVReq(NamedTuple):                       # raft.tla:193-198
+    mterm: int
+    mlastLogTerm: int
+    mlastLogIndex: int
+    msource: int
+    mdest: int
+
+
+class RVResp(NamedTuple):                      # raft.tla:294-301 (no mlog)
+    mterm: int
+    mvoteGranted: bool
+    msource: int
+    mdest: int
+
+
+class AEReq(NamedTuple):                       # raft.tla:215-225 (no mlog)
+    mterm: int
+    mprevLogIndex: int
+    mprevLogTerm: int
+    mentries: tuple                            # () or ((term, value),)
+    mcommitIndex: int
+    msource: int
+    mdest: int
+
+
+class AEResp(NamedTuple):                      # raft.tla:338-343,366-372
+    mterm: int
+    msuccess: bool
+    mmatchIndex: int
+    msource: int
+    mdest: int
+
+
+class State(NamedTuple):
+    """One global state, parity identity.  Per-server values are tuples
+    indexed by server id 0..n-1; ``messages`` is the bag as a frozenset of
+    ``(record, count)`` pairs (a function Message -> Nat, raft.tla:32)."""
+
+    currentTerm: tuple
+    role: tuple                                # 'state' in the spec
+    votedFor: tuple                            # server id or NIL
+    log: tuple                                 # per server: tuple of (term, value)
+    commitIndex: tuple
+    votesResponded: tuple                      # per server: frozenset of ids
+    votesGranted: tuple
+    nextIndex: tuple                           # per server: tuple over peers
+    matchIndex: tuple
+    messages: frozenset
+
+
+def init_state(n: int) -> State:               # raft.tla:140-160
+    return State(
+        currentTerm=(1,) * n,
+        role=(FOLLOWER,) * n,
+        votedFor=(NIL,) * n,
+        log=((),) * n,
+        commitIndex=(0,) * n,
+        votesResponded=(frozenset(),) * n,
+        votesGranted=(frozenset(),) * n,
+        nextIndex=((1,) * n,) * n,
+        matchIndex=((0,) * n,) * n,
+        messages=frozenset(),
+    )
+
+
+# -- bag helpers (raft.tla:106-130) -----------------------------------------
+
+def with_message(m, msgs: frozenset) -> frozenset:     # raft.tla:106-110
+    d = dict(msgs)
+    d[m] = d.get(m, 0) + 1
+    return frozenset(d.items())
+
+
+def without_message(m, msgs: frozenset) -> frozenset:  # raft.tla:114-119
+    d = dict(msgs)
+    if m in d:
+        if d[m] <= 1:
+            del d[m]
+        else:
+            d[m] -= 1
+    return frozenset(d.items())
+
+
+def reply(resp, req, msgs: frozenset) -> frozenset:    # raft.tla:129-130
+    return without_message(req, with_message(resp, msgs))
+
+
+def last_term(xlog: tuple) -> int:                     # raft.tla:102
+    return xlog[-1][0] if xlog else 0
+
+
+def is_quorum(s: frozenset, n: int) -> bool:           # raft.tla:99
+    return 2 * len(s) > n
+
+
+def _upd(t: tuple, i: int, v):
+    return t[:i] + (v,) + t[i + 1:]
+
+
+# -- actions (raft.tla:165-276) ---------------------------------------------
+
+def restart(s: State, i: int) -> State:                # raft.tla:167-175
+    n = len(s.currentTerm)
+    return s._replace(
+        role=_upd(s.role, i, FOLLOWER),
+        votesResponded=_upd(s.votesResponded, i, frozenset()),
+        votesGranted=_upd(s.votesGranted, i, frozenset()),
+        nextIndex=_upd(s.nextIndex, i, (1,) * n),
+        matchIndex=_upd(s.matchIndex, i, (0,) * n),
+        commitIndex=_upd(s.commitIndex, i, 0),
+    )
+
+
+def timeout(s: State, i: int):                         # raft.tla:178-187
+    if s.role[i] not in (FOLLOWER, CANDIDATE):
+        return None
+    return s._replace(
+        role=_upd(s.role, i, CANDIDATE),
+        currentTerm=_upd(s.currentTerm, i, s.currentTerm[i] + 1),
+        votedFor=_upd(s.votedFor, i, NIL),
+        votesResponded=_upd(s.votesResponded, i, frozenset()),
+        votesGranted=_upd(s.votesGranted, i, frozenset()),
+    )
+
+
+def request_vote(s: State, i: int, j: int):            # raft.tla:190-199
+    if s.role[i] != CANDIDATE or j in s.votesResponded[i]:
+        return None
+    m = RVReq(mterm=s.currentTerm[i], mlastLogTerm=last_term(s.log[i]),
+              mlastLogIndex=len(s.log[i]), msource=i, mdest=j)
+    return s._replace(messages=with_message(m, s.messages))
+
+
+def append_entries(s: State, i: int, j: int):          # raft.tla:204-226
+    if i == j or s.role[i] != LEADER:
+        return None
+    prev_idx = s.nextIndex[i][j] - 1
+    prev_term = s.log[i][prev_idx - 1][0] if prev_idx > 0 else 0
+    last_entry = min(len(s.log[i]), s.nextIndex[i][j])
+    # SubSeq(log, nextIndex, lastEntry), 1-based inclusive (raft.tla:214)
+    entries = tuple(s.log[i][s.nextIndex[i][j] - 1:last_entry])
+    m = AEReq(mterm=s.currentTerm[i], mprevLogIndex=prev_idx,
+              mprevLogTerm=prev_term, mentries=entries,
+              mcommitIndex=min(s.commitIndex[i], last_entry),
+              msource=i, mdest=j)
+    return s._replace(messages=with_message(m, s.messages))
+
+
+def become_leader(s: State, i: int):                   # raft.tla:229-243
+    n = len(s.currentTerm)
+    if s.role[i] != CANDIDATE or not is_quorum(s.votesGranted[i], n):
+        return None
+    return s._replace(
+        role=_upd(s.role, i, LEADER),
+        nextIndex=_upd(s.nextIndex, i, (len(s.log[i]) + 1,) * n),
+        matchIndex=_upd(s.matchIndex, i, (0,) * n),
+    )
+
+
+def client_request(s: State, i: int, v: int):          # raft.tla:246-253
+    if s.role[i] != LEADER:
+        return None
+    entry = (s.currentTerm[i], v)
+    return s._replace(log=_upd(s.log, i, s.log[i] + (entry,)))
+
+
+def advance_commit_index(s: State, i: int):            # raft.tla:259-276
+    if s.role[i] != LEADER:
+        return None
+    n = len(s.currentTerm)
+
+    def agree(index):                                  # raft.tla:262-263
+        return frozenset({i} | {k for k in range(n)
+                                if s.matchIndex[i][k] >= index})
+    agree_indexes = [x for x in range(1, len(s.log[i]) + 1)
+                     if is_quorum(agree(x), n)]
+    if agree_indexes and \
+            s.log[i][max(agree_indexes) - 1][0] == s.currentTerm[i]:
+        new_ci = max(agree_indexes)                    # raft.tla:268-272
+    else:
+        new_ci = s.commitIndex[i]
+    return s._replace(commitIndex=_upd(s.commitIndex, i, new_ci))
+
+
+# -- message handlers (raft.tla:282-436) ------------------------------------
+
+def receive(s: State, m) -> list:
+    """All enabled ``Receive(m)`` outcomes (raft.tla:421-436).  The guards
+    partition on mterm vs currentTerm[i], so at most one disjunct fires."""
+    i, j = m.mdest, m.msource
+    if m.mterm > s.currentTerm[i]:                     # UpdateTerm, 406-412
+        return [s._replace(                            # message NOT consumed
+            currentTerm=_upd(s.currentTerm, i, m.mterm),
+            role=_upd(s.role, i, FOLLOWER),
+            votedFor=_upd(s.votedFor, i, NIL))]
+    if isinstance(m, RVReq):
+        return _handle_rv_req(s, i, j, m)
+    if isinstance(m, RVResp):
+        if m.mterm < s.currentTerm[i]:                 # DropStale, 415-418
+            return [s._replace(messages=without_message(m, s.messages))]
+        return _handle_rv_resp(s, i, j, m)
+    if isinstance(m, AEReq):
+        return _handle_ae_req(s, i, j, m)
+    if isinstance(m, AEResp):
+        if m.mterm < s.currentTerm[i]:                 # DropStale, 415-418
+            return [s._replace(messages=without_message(m, s.messages))]
+        return _handle_ae_resp(s, i, j, m)
+    raise TypeError(m)
+
+
+def _handle_rv_req(s, i, j, m):                        # raft.tla:284-303
+    # here m.mterm <= currentTerm[i] holds (UpdateTerm took the > case)
+    log_ok = (m.mlastLogTerm > last_term(s.log[i])
+              or (m.mlastLogTerm == last_term(s.log[i])
+                  and m.mlastLogIndex >= len(s.log[i])))
+    grant = (m.mterm == s.currentTerm[i] and log_ok
+             and s.votedFor[i] in (NIL, j))
+    resp = RVResp(mterm=s.currentTerm[i], mvoteGranted=grant,
+                  msource=i, mdest=j)
+    out = s._replace(messages=reply(resp, m, s.messages))
+    if grant:
+        out = out._replace(votedFor=_upd(out.votedFor, i, j))
+    return [out]
+
+
+def _handle_rv_resp(s, i, j, m):                       # raft.tla:307-321
+    if m.mterm != s.currentTerm[i]:
+        return []
+    out = s._replace(
+        votesResponded=_upd(s.votesResponded, i,
+                            s.votesResponded[i] | {j}),
+        messages=without_message(m, s.messages))
+    if m.mvoteGranted:
+        out = out._replace(
+            votesGranted=_upd(out.votesGranted, i,
+                              s.votesGranted[i] | {j}))
+    return [out]
+
+
+def _handle_ae_req(s, i, j, m):                        # raft.tla:327-389
+    # here m.mterm <= currentTerm[i]
+    log_ok = (m.mprevLogIndex == 0
+              or (0 < m.mprevLogIndex <= len(s.log[i])
+                  and m.mprevLogTerm == s.log[i][m.mprevLogIndex - 1][0]))
+    outs = []
+    if (m.mterm < s.currentTerm[i]
+            or (m.mterm == s.currentTerm[i] and s.role[i] == FOLLOWER
+                and not log_ok)):                      # reject, 333-345
+        resp = AEResp(mterm=s.currentTerm[i], msuccess=False,
+                      mmatchIndex=0, msource=i, mdest=j)
+        outs.append(s._replace(messages=reply(resp, m, s.messages)))
+    if m.mterm == s.currentTerm[i] and s.role[i] == CANDIDATE:
+        # return to follower state, message kept (346-350)
+        outs.append(s._replace(role=_upd(s.role, i, FOLLOWER)))
+    if m.mterm == s.currentTerm[i] and s.role[i] == FOLLOWER and log_ok:
+        index = m.mprevLogIndex + 1                    # accept, 351-388
+        if (m.mentries == ()
+                or (len(s.log[i]) >= index
+                    and s.log[i][index - 1][0] == m.mentries[0][0])):
+            # already done with request (356-374); commitIndex may decrease
+            resp = AEResp(mterm=s.currentTerm[i], msuccess=True,
+                          mmatchIndex=m.mprevLogIndex + len(m.mentries),
+                          msource=i, mdest=j)
+            outs.append(s._replace(
+                commitIndex=_upd(s.commitIndex, i, m.mcommitIndex),
+                messages=reply(resp, m, s.messages)))
+        if (m.mentries != () and len(s.log[i]) >= index
+                and s.log[i][index - 1][0] != m.mentries[0][0]):
+            # conflict: drop the LAST entry, message kept (375-382)
+            outs.append(s._replace(log=_upd(s.log, i, s.log[i][:-1])))
+        if m.mentries != () and len(s.log[i]) == m.mprevLogIndex:
+            # no conflict: append entry, message kept (383-388)
+            outs.append(s._replace(
+                log=_upd(s.log, i, s.log[i] + (m.mentries[0],))))
+    return outs
+
+
+def _handle_ae_resp(s, i, j, m):                       # raft.tla:393-403
+    if m.mterm != s.currentTerm[i]:
+        return []
+    if m.msuccess:
+        ni = _upd(s.nextIndex[i], j, m.mmatchIndex + 1)
+        mi = _upd(s.matchIndex[i], j, m.mmatchIndex)
+        out = s._replace(nextIndex=_upd(s.nextIndex, i, ni),
+                         matchIndex=_upd(s.matchIndex, i, mi))
+    else:
+        ni = _upd(s.nextIndex[i], j, max(s.nextIndex[i][j] - 1, 1))
+        out = s._replace(nextIndex=_upd(s.nextIndex, i, ni))
+    return [out._replace(messages=without_message(m, out.messages))]
+
+
+# -- Next (raft.tla:454-465) and bounded BFS --------------------------------
+
+def successors(s: State, n: int, values: int) -> list:
+    """Every state reachable in one ``Next`` step (parity identity)."""
+    out = []
+    for i in range(n):
+        out.append(restart(s, i))                      # raft.tla:454
+        out.append(timeout(s, i))                      # raft.tla:455
+        for j in range(n):
+            out.append(request_vote(s, i, j))          # raft.tla:456
+            out.append(append_entries(s, i, j))        # raft.tla:460
+        out.append(become_leader(s, i))                # raft.tla:457
+        for v in range(1, values + 1):
+            out.append(client_request(s, i, v))        # raft.tla:458
+        out.append(advance_commit_index(s, i))         # raft.tla:459
+    for m, _count in s.messages:                       # raft.tla:461-463
+        out.extend(receive(s, m))
+        out.append(s._replace(messages=with_message(m, s.messages)))
+        out.append(s._replace(messages=without_message(m, s.messages)))
+    return [t for t in out if t is not None]
+
+
+def constraint_ok(s: State, max_term: int, max_log: int, max_msgs: int,
+                  max_dup: int) -> bool:
+    """The StateConstraint (SURVEY §0 defect 2) — same bound the package
+    enforces via its tensor encoding."""
+    return (all(t <= max_term for t in s.currentTerm)
+            and all(len(lg) <= max_log for lg in s.log)
+            and len(s.messages) <= max_msgs
+            and all(c <= max_dup for _m, c in s.messages))
+
+
+def bfs(n: int, values: int, max_term: int, max_log: int, max_msgs: int,
+        max_dup: int = 1, max_levels: int | None = None) -> list:
+    """Exhaustive bounded BFS; returns per-level new-state counts.
+    Constraint-violating states are discovered and counted but never
+    expanded (TLC CONSTRAINT semantics)."""
+    init = init_state(n)
+    seen = {init}
+    frontier = [init]
+    levels = [1]
+    while frontier and (max_levels is None or len(levels) <= max_levels):
+        nxt = []
+        for s in frontier:
+            if not constraint_ok(s, max_term, max_log, max_msgs, max_dup):
+                continue
+            for t in successors(s, n, values):
+                if t not in seen:
+                    seen.add(t)
+                    nxt.append(t)
+        if nxt:
+            levels.append(len(nxt))
+        frontier = nxt
+    return levels

@@ -154,3 +154,60 @@ def test_leader_completeness_py_jnp_agree():
                            np)
         got = bool(dev({k: jnp.asarray(v) for k, v in struct.items()}))
         assert got is want
+
+
+# -- engine-built graphs (models/liveness.engine_graph) ----------------------
+
+def _graphs_equal_verdicts(config, props_wf):
+    """engine_graph and explore_graph must yield identical verdicts,
+    state/edge counts, and (where refuted) replayable lassos."""
+    g_int = liveness.explore_graph(config)
+    g_eng = liveness.engine_graph(config)
+    assert len(g_eng[0]) == len(g_int[0])                   # states
+    assert sum(map(len, g_eng[1])) == sum(map(len, g_int[1]))  # edges
+    for prop, wf in props_wf:
+        ri = liveness.check(config, prop, wf=wf, graph=g_int)
+        re = liveness.check(config, prop, wf=wf, graph=g_eng)
+        assert ri.holds == re.holds, (prop, wf)
+        assert (ri.n_states, ri.n_edges) == (re.n_states, re.n_edges)
+        if not re.holds:
+            replay_lasso(re.violation, config)
+
+
+def test_engine_graph_matches_interpreter_election():
+    _graphs_equal_verdicts(ELECTION, [
+        ("EventuallyLeader", ("Next",)),
+        ("EventuallyLeader", ()),
+    ])
+
+
+def test_engine_graph_matches_interpreter_full_spec():
+    _graphs_equal_verdicts(FULL, [
+        ("EventuallyLeader", ("Next",)),
+        ("EventuallyCommit", ("Next",)),
+    ])
+
+
+def test_engine_graph_rejects_symmetry():
+    cfg = CheckConfig(bounds=B2, spec="election", invariants=(),
+                      symmetry=("Server",))
+    with pytest.raises(ValueError, match="SYMMETRY"):
+        liveness.engine_graph(cfg)
+
+
+def test_engine_graph_at_scale_3server_election():
+    """VERDICT r1 next#8's 'done' gate: an EventuallyLeader verdict on the
+    142,538-state 3-server election universe from an engine-built graph.
+    (The interpreter path needs tens of minutes here; the engine graph
+    builds in about a minute even on the CPU test backend.)"""
+    cfg = CheckConfig(
+        bounds=Bounds(n_servers=3, n_values=1, max_term=2, max_log=0,
+                      max_msgs=1),
+        spec="election", invariants=(), chunk=1024)
+    from raft_tla_tpu.device_engine import Capacities
+    graph = liveness.engine_graph(cfg, Capacities(n_states=1 << 18,
+                                                  levels=64))
+    assert len(graph[0]) == 142538
+    r = liveness.check(cfg, "EventuallyLeader", wf=("Next",), graph=graph)
+    assert r.n_states == 142538
+    assert r.holds and r.violation is None
