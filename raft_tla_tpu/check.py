@@ -42,11 +42,14 @@ def build_argparser() -> argparse.ArgumentParser:
                    choices=("full", "election", "replication"),
                    help="Next-disjunct subset (default: full raft.tla:454-465)")
     p.add_argument("--engine", default="device",
-                   choices=("device", "paged", "shard", "host", "ref"),
+                   choices=("device", "paged", "shard", "pagedshard",
+                            "host", "ref"),
                    help="device: search resident in HBM; paged: HBM ring + "
                         "native host store (capacity bounded by host RAM); "
-                        "shard: multi-chip mesh; host: per-chunk jit; "
-                        "ref: pure-Python oracle")
+                        "shard: multi-chip mesh; pagedshard: mesh whose "
+                        "per-device stores page to host RAM (the "
+                        "largest-capacity configuration); host: per-chunk "
+                        "jit; ref: pure-Python oracle")
     p.add_argument("--max-term", type=int, default=3,
                    help="CONSTRAINT: currentTerm[i] <= N (default 3)")
     p.add_argument("--max-log", type=int, default=2,
@@ -297,6 +300,28 @@ def _run(args, config):
         return eng.check(checkpoint=args.checkpoint,
                          checkpoint_every_s=args.checkpoint_every,
                          resume=args.resume, on_progress=_stats_cb(args))
+    if args.engine == "pagedshard":
+        from raft_tla_tpu.models import spec as S
+        from raft_tla_tpu.parallel.paged_shard_engine import (
+            PagedShardCapacities, PagedShardEngine)
+        from raft_tla_tpu.parallel.shard_engine import make_mesh
+        A = len(S.action_table(config.bounds, config.spec))
+        # --cap is the expected distinct-state total across the mesh;
+        # tables shard it, rings hold each device's live window share
+        table = 1 << max(1, (2 * args.cap - 1).bit_length())
+        mesh = make_mesh(args.devices)
+        nd = mesh.devices.size
+        ring = args.ring if args.ring is not None else max(
+            1 << min(22, max(12, (args.cap // (4 * nd)).bit_length())),
+            1 << (2 * args.chunk * A - 1).bit_length())
+        # per-device table share, rounded up to a power of two (the
+        # bucket mask is bitwise)
+        tbl_d = 1 << max(10, ((table + nd - 1) // nd - 1).bit_length())
+        eng = PagedShardEngine(config, mesh, PagedShardCapacities(
+            ring=ring, table=tbl_d, levels=args.levels))
+        return eng.check(checkpoint=args.checkpoint,
+                         checkpoint_every_s=args.checkpoint_every,
+                         resume=args.resume, on_progress=_stats_cb(args))
     from raft_tla_tpu.device_engine import Capacities, DeviceEngine
     eng = DeviceEngine(config, Capacities(n_states=args.cap,
                                           levels=args.levels))
@@ -309,12 +334,13 @@ def main(argv=None) -> int:
     p = build_argparser()
     args = p.parse_args(argv)
     if (args.checkpoint or args.resume) and args.engine not in (
-            "device", "paged", "shard"):
-        p.error(f"--checkpoint/--resume require --engine device, paged or "
-                f"shard (got {args.engine}); other engines would silently "
+            "device", "paged", "shard", "pagedshard"):
+        p.error(f"--checkpoint/--resume require a device-class engine "
+                f"(got {args.engine}); other engines would silently "
                 "ignore them")
-    if args.stats and args.engine not in ("device", "paged", "shard"):
-        p.error(f"--stats requires --engine device, paged or shard "
+    if args.stats and args.engine not in ("device", "paged", "shard",
+                                          "pagedshard"):
+        p.error(f"--stats requires a device-class engine "
                 f"(got {args.engine})")
     try:
         config, props = _resolve_config(args)
