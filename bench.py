@@ -1,17 +1,24 @@
-"""Headline benchmark: exhaustive model checking throughput on one chip.
+"""Headline benchmark: north-star-shaped throughput on one chip.
 
-Runs the device-resident checker (``raft_tla_tpu.device_engine``) over a
-fixed suite of exhaustively-checkable Raft models (election sub-spec and the
-full ``Next`` with crash/duplicate/drop faults — BASELINE.md configs #2/#4
-scaled to single-chip HBM), invariants on, and reports warm throughput.
-Each suite entry runs in its own subprocess: building several engines in one
-process can wedge the TPU worker (see .claude/skills/verify/SKILL.md).
+Two parts, each in its own subprocess (building several engines in one
+process can wedge the TPU worker — .claude/skills/verify/SKILL.md):
+
+1. **North-star probe** (the headline): a time-boxed segment of the
+   symmetric full-``Next`` reference universe (3s/2v, t2 l1 m2,
+   SYMMETRY Server — the exact workload the round-1 flagship completed
+   exhaustively at 94,396,461 orbits) on the host-paged engine, warm
+   orbits/s measured after the compile-carrying segment.
+2. **Toy suite** (secondary, kept for cross-round comparability):
+   election-3s + full-2s on the HBM-resident engine, warm.
 
 The reference publishes no performance numbers (BASELINE.md: ``"published":
-{}``), so ``vs_baseline`` is measured against the driver's north-star budget:
-the BASELINE.json target of an exhaustive, invariant-checked run in under
-60 s.  ``vs_baseline = 60 / suite_wall_s`` — > 1 means the whole suite
-finishes inside the north-star budget.
+{}``), so ``vs_baseline`` is measured against the driver's north-star
+budget — exhaustive + invariant-checked in under 60 s.  Round 1 scored the
+toy suite against that budget, which flattered (VERDICT r1 weak #6); the
+headline is now **the projected wall for the known 94.4M-orbit flagship
+space**: ``vs_baseline = 60 s / (94,396,461 / orbits_per_sec)``.  > 1
+means the full reference universe, symmetric and fault-complete, would
+finish inside the budget at the measured sustained rate.
 
 Prints exactly one JSON line on stdout; human detail goes to stderr.
 """
@@ -21,8 +28,12 @@ import subprocess
 import sys
 import time
 
-# Single source of truth for the suite; configs are built lazily in the
-# child so the parent never imports jax.
+# The round-1 flagship exhaustive result (RESULTS.md): the reference
+# raft.cfg universe under t2/l1/m2, SYMMETRY Server — the denominator for
+# the projected-wall headline.
+FLAGSHIP_ORBITS = 94_396_461
+NORTHSTAR_DEADLINE_S = 40.0
+
 SUITE_NAMES = ("election-3s", "full-2s-faults")
 SUITE_SIZE = len(SUITE_NAMES)
 
@@ -54,7 +65,7 @@ def _suite():
 
 
 def run_one(idx: int) -> None:
-    """Child process: run suite entry ``idx``, print its JSON to stdout."""
+    """Child process: run toy-suite entry ``idx``, print its JSON."""
     from raft_tla_tpu.device_engine import DeviceEngine
 
     name, cfg, caps = _suite()[idx]
@@ -69,7 +80,59 @@ def run_one(idx: int) -> None:
     }))
 
 
+def run_northstar() -> None:
+    """Child process: the time-boxed symmetric full-``Next`` 3s/2v probe."""
+    from raft_tla_tpu.config import Bounds, CheckConfig
+    from raft_tla_tpu.paged_engine import PagedCapacities, PagedEngine
+
+    cfg = CheckConfig(
+        bounds=Bounds(n_servers=3, n_values=2, max_term=2, max_log=1,
+                      max_msgs=2, max_dup=1),
+        spec="full",
+        invariants=("NoTwoLeaders", "LogMatching", "CommittedWithinLog",
+                    "LeaderCompleteness"),
+        symmetry=("Server",), chunk=2048)
+    eng = PagedEngine(cfg, PagedCapacities(ring=1 << 21, table=1 << 23,
+                                           levels=128))
+    stats: list = []
+    r = eng.check(deadline_s=NORTHSTAR_DEADLINE_S, on_progress=stats.append)
+    # warm rate: orbits found after the first (compile-carrying) segment,
+    # whenever the stats stream allows it — completed-in-box runs included
+    if len(stats) >= 2:
+        d_orbits = stats[-1]["n_states"] - stats[0]["n_states"]
+        d_wall = stats[-1]["wall_s"] - stats[0]["wall_s"]
+    else:                                   # single-segment run: no split
+        d_orbits, d_wall = r.n_states, r.wall_s
+    print(json.dumps({
+        "orbits": r.n_states, "level": stats[-1]["level"] if stats else 0,
+        "orbits_per_sec": d_orbits / max(d_wall, 1e-9),
+        "violation": r.violation is not None,
+    }))
+
+
 def main() -> None:
+    # -- part 1: the north-star probe --------------------------------------
+    proc = subprocess.run(
+        [sys.executable, __file__, "--northstar"],
+        capture_output=True, text=True, timeout=900)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr)
+        print("bench northstar probe failed", file=sys.stderr)
+        sys.exit(1)
+    ns = json.loads(proc.stdout.strip().splitlines()[-1])
+    if ns["violation"]:
+        print("bench northstar: unexpected invariant violation",
+              file=sys.stderr)
+        sys.exit(1)
+    rate = ns["orbits_per_sec"]
+    projected_flagship_wall = FLAGSHIP_ORBITS / max(rate, 1e-9)
+    print(f"northstar probe: {ns['orbits']:,} orbits to level "
+          f"{ns['level']} in the {NORTHSTAR_DEADLINE_S:.0f}s box, warm "
+          f"{rate:,.0f} orbits/s -> projected flagship "
+          f"(94.4M-orbit) wall {projected_flagship_wall:,.0f}s",
+          file=sys.stderr)
+
+    # -- part 2: the toy suite (secondary) ---------------------------------
     total_states = 0
     total_wall = 0.0
     for idx in range(SUITE_SIZE):
@@ -93,15 +156,22 @@ def main() -> None:
               file=sys.stderr)
 
     print(json.dumps({
-        "metric": "exhaustive_check_states_per_sec_single_chip",
-        "value": round(total_states / total_wall, 1),
-        "unit": "states/s",
-        "vs_baseline": round(60.0 / total_wall, 2),
+        "metric": "symmetric_fullnext_orbits_per_sec_single_chip",
+        "value": round(rate, 1),
+        "unit": "orbits/s",
+        # 60 s north-star budget vs the projected wall for the KNOWN
+        # 94.4M-orbit flagship space at the measured sustained rate
+        "vs_baseline": round(60.0 / projected_flagship_wall, 4),
+        "projected_flagship_wall_s": round(projected_flagship_wall, 1),
+        "toy_suite_states_per_sec": round(total_states / total_wall, 1),
+        "toy_suite_vs_60s_budget": round(60.0 / total_wall, 2),
     }))
 
 
 if __name__ == "__main__":
     if len(sys.argv) == 3 and sys.argv[1] == "--one":
         run_one(int(sys.argv[2]))
+    elif len(sys.argv) == 2 and sys.argv[1] == "--northstar":
+        run_northstar()
     else:
         main()

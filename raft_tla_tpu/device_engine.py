@@ -447,13 +447,17 @@ def _build_init(caps: Capacities, A: int, W: int):
     return init
 
 
-def _progress_stats(carry: Carry, t0: float) -> dict:
-    """One batched transfer of the run's live counters (SURVEY §5)."""
-    n_states, lvl, n_trans = jax.device_get(
-        (carry.n_states, carry.lvl, carry.n_trans))
+def _progress_stats(carry: Carry, t0: float, table=None) -> dict:
+    """One batched transfer of the run's live counters (SURVEY §5).
+
+    With ``table`` (the engine's action table) the dict also carries the
+    live per-action-family coverage — TLC's ``-coverage 1`` minute-ticker
+    analog (/root/reference/.vscode/settings.json:4), here per segment."""
+    n_states, lvl, n_trans, cov = jax.device_get(
+        (carry.n_states, carry.lvl, carry.n_trans, carry.cov))
     wall = time.monotonic() - t0
     n_states, n_trans = int(n_states), acc64_int(n_trans)
-    return {
+    out = {
         "wall_s": round(wall, 3),
         "n_states": n_states,
         "level": int(lvl),
@@ -465,6 +469,14 @@ def _progress_stats(carry: Carry, t0: float) -> dict:
                                 4),
         "states_per_sec": round(n_states / max(wall, 1e-9), 1),
     }
+    if table is not None:
+        cov = np.asarray(cov).reshape(-1, len(table)).sum(axis=0)
+        agg: dict = {}
+        for a, inst in enumerate(table):
+            if cov[a]:
+                agg[inst.family] = agg.get(inst.family, 0) + int(cov[a])
+        out["coverage"] = agg
+    return out
 
 
 class DeviceEngine:
@@ -578,7 +590,7 @@ class DeviceEngine:
             t_seg = time.monotonic()
             carry, done = self._segment(carry, jnp.int32(budget))
             if on_progress is not None:
-                on_progress(_progress_stats(carry, t0))
+                on_progress(_progress_stats(carry, t0, self.table))
             if bool(done):
                 break
             dt = time.monotonic() - t_seg

@@ -71,6 +71,10 @@ class EngineResult:
     violation: Optional[Violation]
     levels: list           # new-state count per level (levels[0] = 1)
     wall_s: float
+    # False only for deadline-bounded partial runs (PagedEngine.check
+    # deadline_s — the bench's time-boxed north-star workload); every
+    # exhaustive verdict above requires complete=True.
+    complete: bool = True
 
     @property
     def states_per_sec(self) -> float:

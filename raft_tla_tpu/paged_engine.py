@@ -343,10 +343,16 @@ class PagedEngine:
     def check(self, init_override: interp.PyState | None = None,
               on_progress=None, checkpoint: str | None = None,
               checkpoint_every_s: float = 300.0,
-              resume: str | None = None) -> EngineResult:
+              resume: str | None = None,
+              deadline_s: float | None = None) -> EngineResult:
         """``on_progress`` as in DeviceEngine.check: structured per-segment
         run stats (SURVEY §5).  ``checkpoint``/``resume`` as in
-        DeviceEngine, additionally snapshotting the host store."""
+        DeviceEngine, additionally snapshotting the host store.
+
+        ``deadline_s`` time-boxes the search: segments stop once that many
+        seconds have passed AFTER the first (compile-carrying) segment, and
+        the result comes back with ``complete=False`` and the counts found
+        so far — the bench's north-star-shaped throughput probe."""
         t0 = time.monotonic()
         bounds = self.bounds
         init_py = init_override if init_override is not None \
@@ -375,9 +381,15 @@ class PagedEngine:
             paged = 0
         budget = max(1, self.seg_chunks)
         first = True
+        complete = True
+        t_warm = None
         worst_s_per_chunk = 0.0
         last_ckpt = time.monotonic()
         while True:
+            if (deadline_s is not None and t_warm is not None
+                    and time.monotonic() - t_warm > deadline_s):
+                complete = False
+                break
             # Pause the device loop before unpaged rows could be overwritten:
             # rows < pause_at are safe while n_states - lvl_start <= ring.
             pause_at = paged + self.caps.ring // 2
@@ -387,7 +399,7 @@ class PagedEngine:
             n_states = int(carry.n_states)
             paged = self._pageout(carry, host, paged, n_states)
             if on_progress is not None:
-                on_progress(_progress_stats(carry, t0))
+                on_progress(_progress_stats(carry, t0, self.table))
             if bool(done):
                 break
             dt = time.monotonic() - t_seg
@@ -406,6 +418,8 @@ class PagedEngine:
                                  max(self.SEG_MIN, budget * scale)))
                 budget = max(self.SEG_MIN, min(
                     budget, int(self.SEG_CLAMP_S / worst_s_per_chunk)))
+            if first:
+                t_warm = time.monotonic()   # deadline starts post-compile
             first = False
 
         (viol_g, viol_i, n_trans, fail, n_levels, levels_dev,
@@ -446,7 +460,7 @@ class PagedEngine:
             n_states=n_states, diameter=len(levels_arr) - 1,
             n_transitions=acc64_int(n_trans), coverage=coverage,
             violation=violation, levels=levels_arr,
-            wall_s=time.monotonic() - t0)
+            wall_s=time.monotonic() - t0, complete=complete)
 
 
 def check(config: CheckConfig, caps: PagedCapacities | None = None,

@@ -130,7 +130,10 @@ def _build_segment(config: CheckConfig, caps: PagedShardCapacities, A: int,
     Pw = schema.P
     Csend = caps.send if caps.send is not None else B * A
     BIG = jnp.int32(np.iinfo(np.int32).max)
-    IDX_CEIL = jnp.int32(np.iinfo(np.int32).max - 2 * B * A)
+    # Index-ceiling headroom must cover the worst-case per-chunk append,
+    # which here is ndev*Csend (every sender fills this owner's routing
+    # buffer) — not the single-device engine's 2*B*A.
+    IDX_CEIL = jnp.int32(np.iinfo(np.int32).max - 2 * ndev * Csend)
 
     def owner(key_hi):
         return (key_hi % jnp.uint32(ndev)).astype(I32)

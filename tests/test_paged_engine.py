@@ -118,3 +118,30 @@ def test_matches_device_engine_discovery_order():
                       ).check(init_override=start)
     assert [l for l, _ in pag.violation.trace] == \
         [l for l, _ in dev.violation.trace]
+
+
+def test_deadline_partial_run_and_live_coverage():
+    """deadline_s time-boxes the search (bench's north-star probe): the
+    partial result is marked complete=False, and the --stats stream
+    carries live per-action coverage (TLC -coverage 1 analog)."""
+    from raft_tla_tpu.config import Bounds, CheckConfig
+    from raft_tla_tpu.paged_engine import PagedCapacities, PagedEngine
+
+    cfg = CheckConfig(bounds=Bounds(n_servers=3, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=1),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=64)
+    caps = PagedCapacities(ring=1 << 16, table=1 << 18, levels=64)
+    full = PagedEngine(cfg, caps).check()
+    assert full.complete and full.n_states == 142538
+
+    stats: list = []
+    eng = PagedEngine(cfg, caps, seg_chunks=4)
+    eng.SEG_MAX = 4                     # many tiny segments
+    part = eng.check(deadline_s=0.0, on_progress=stats.append)
+    assert not part.complete
+    assert 0 < part.n_states < full.n_states
+    assert stats and "coverage" in stats[-1]
+    cov = stats[-1]["coverage"]
+    assert sum(cov.values()) == part.n_states - 1   # every non-Init credited
+    assert "Timeout" in cov
