@@ -107,6 +107,7 @@ def run_northstar() -> None:
         "orbits": r.n_states, "level": stats[-1]["level"] if stats else 0,
         "orbits_per_sec": d_orbits / max(d_wall, 1e-9),
         "violation": r.violation is not None,
+        "complete": r.complete, "wall_s": r.wall_s,
     }))
 
 
@@ -125,7 +126,13 @@ def main() -> None:
               file=sys.stderr)
         sys.exit(1)
     rate = ns["orbits_per_sec"]
-    projected_flagship_wall = FLAGSHIP_ORBITS / max(rate, 1e-9)
+    if ns["complete"]:
+        # the probe ran the whole flagship space inside the box (a future-
+        # fast regime, or a drifted probe config — either way the honest
+        # number is the measured wall, not a projection)
+        projected_flagship_wall = ns["wall_s"]
+    else:
+        projected_flagship_wall = FLAGSHIP_ORBITS / max(rate, 1e-9)
     print(f"northstar probe: {ns['orbits']:,} orbits to level "
           f"{ns['level']} in the {NORTHSTAR_DEADLINE_S:.0f}s box, warm "
           f"{rate:,.0f} orbits/s -> projected flagship "

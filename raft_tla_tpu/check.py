@@ -42,14 +42,15 @@ def build_argparser() -> argparse.ArgumentParser:
                    choices=("full", "election", "replication"),
                    help="Next-disjunct subset (default: full raft.tla:454-465)")
     p.add_argument("--engine", default="device",
-                   choices=("device", "paged", "shard", "pagedshard",
-                            "host", "ref"),
+                   choices=("device", "paged", "streamed", "shard",
+                            "pagedshard", "host", "ref"),
                    help="device: search resident in HBM; paged: HBM ring + "
                         "native host store (capacity bounded by host RAM); "
-                        "shard: multi-chip mesh; pagedshard: mesh whose "
-                        "per-device stores page to host RAM (the "
-                        "largest-capacity configuration); host: per-chunk "
-                        "jit; ref: pure-Python oracle")
+                        "streamed: host-streamed frontier (no live-window "
+                        "ceiling — for spaces whose BFS levels outgrow any "
+                        "ring); shard: multi-chip mesh; pagedshard: mesh "
+                        "whose per-device stores page to host RAM; host: "
+                        "per-chunk jit; ref: pure-Python oracle")
     p.add_argument("--max-term", type=int, default=3,
                    help="CONSTRAINT: currentTerm[i] <= N (default 3)")
     p.add_argument("--max-log", type=int, default=2,
@@ -291,6 +292,17 @@ def _run(args, config):
                          checkpoint=args.checkpoint,
                          checkpoint_every_s=args.checkpoint_every,
                          resume=args.resume)
+    if args.engine == "streamed":
+        from raft_tla_tpu.streamed_engine import (StreamedCapacities,
+                                                  StreamedEngine)
+        table = 1 << max(1, (2 * args.cap - 1).bit_length())
+        ring = args.ring if args.ring is not None else 1 << 22
+        eng = StreamedEngine(config, StreamedCapacities(
+            block=1 << 20, ring=ring, table=table, levels=args.levels))
+        return eng.check(on_progress=_stats_cb(args),
+                         checkpoint=args.checkpoint,
+                         checkpoint_every_s=args.checkpoint_every,
+                         resume=args.resume)
     if args.engine == "shard":
         from raft_tla_tpu.parallel.shard_engine import (
             ShardCapacities, ShardEngine, make_mesh)
@@ -333,13 +345,13 @@ def _run(args, config):
 def main(argv=None) -> int:
     p = build_argparser()
     args = p.parse_args(argv)
-    if (args.checkpoint or args.resume) and args.engine not in (
-            "device", "paged", "shard", "pagedshard"):
+    _DEVICE_ENGINES = ("device", "paged", "streamed", "shard", "pagedshard")
+    if (args.checkpoint or args.resume) and \
+            args.engine not in _DEVICE_ENGINES:
         p.error(f"--checkpoint/--resume require a device-class engine "
                 f"(got {args.engine}); other engines would silently "
                 "ignore them")
-    if args.stats and args.engine not in ("device", "paged", "shard",
-                                          "pagedshard"):
+    if args.stats and args.engine not in _DEVICE_ENGINES:
         p.error(f"--stats requires a device-class engine "
                 f"(got {args.engine})")
     try:

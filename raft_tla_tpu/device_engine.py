@@ -447,6 +447,17 @@ def _build_init(caps: Capacities, A: int, W: int):
     return init
 
 
+def aggregate_coverage(table, cov) -> Counter:
+    """Per-action-family coverage from the device counters ([.., A]) —
+    ONE definition for every engine's result assembly and stats stream."""
+    cov = np.asarray(cov).reshape(-1, len(table)).sum(axis=0)
+    out: Counter = Counter()
+    for a, inst in enumerate(table):
+        if cov[a]:
+            out[inst.family] += int(cov[a])
+    return out
+
+
 def _progress_stats(carry: Carry, t0: float, table=None) -> dict:
     """One batched transfer of the run's live counters (SURVEY §5).
 
@@ -470,12 +481,7 @@ def _progress_stats(carry: Carry, t0: float, table=None) -> dict:
         "states_per_sec": round(n_states / max(wall, 1e-9), 1),
     }
     if table is not None:
-        cov = np.asarray(cov).reshape(-1, len(table)).sum(axis=0)
-        agg: dict = {}
-        for a, inst in enumerate(table):
-            if cov[a]:
-                agg[inst.family] = agg.get(inst.family, 0) + int(cov[a])
-        out["coverage"] = agg
+        out["coverage"] = dict(aggregate_coverage(table, cov))
     return out
 
 

@@ -1,0 +1,129 @@
+"""Host-streamed frontier engine (streamed_engine.py).
+
+The engine exists because level windows outgrow any legal HBM ring (the
+elect5 runs FAIL_RING'd at ring 2^25 — runs/elect5v2.stats); its gates:
+oracle-exact parity with blocks/rings small enough to cycle many times,
+completion of a space whose live window exceeds the ring, trace replay,
+and block-boundary checkpoint/resume with exact counters.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.models import interp, refbfs
+from raft_tla_tpu.streamed_engine import StreamedCapacities, StreamedEngine
+
+CFG = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                max_log=0, max_msgs=2),
+                  spec="election", invariants=("NoTwoLeaders",), chunk=32)
+CAPS = StreamedCapacities(block=256, ring=4096, table=1 << 14, levels=64)
+
+
+def test_parity_with_oracle_tiny_block_and_ring():
+    ref = refbfs.check(CFG)
+    got = StreamedEngine(CFG, CAPS).check()
+    assert got.n_states == ref.n_states == 3014
+    assert got.diameter == ref.diameter == 17
+    assert got.levels == ref.levels
+    assert got.n_transitions == ref.n_transitions
+    assert got.coverage == ref.coverage      # identical discovery order
+    assert got.violation is None and got.complete
+
+
+def test_window_past_any_ring_completes():
+    """The 3-server election space's widest level pair (~45k rows) exceeds
+    a 4096-row ring many times over — the paged engine would FAIL_RING;
+    the streamed engine only buffers appends in the ring and completes."""
+    cfg = CheckConfig(bounds=Bounds(n_servers=3, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=1),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=64)
+    caps = StreamedCapacities(block=1 << 13, ring=4096, table=1 << 19,
+                              levels=64)
+    got = StreamedEngine(cfg, caps).check()
+    assert got.n_states == 142538
+    assert got.diameter == 31
+    assert got.complete
+
+
+def test_violation_trace_replays():
+    from raft_tla_tpu.models import invariants as inv_mod
+    from raft_tla_tpu.models import spec as S
+    from raft_tla_tpu.ops import msgbits as mb
+
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=4, max_dup=1)
+    cfg = CheckConfig(bounds=bounds, spec="election",
+                      invariants=("NaiveNoTwoLeaders",), chunk=64)
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3),
+        votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100),
+        msgs=tuple(sorted((m, 1) for m in
+                          (mb.rv_response(3, 1, 1, 2),))),
+    )
+    caps = StreamedCapacities(block=1 << 12, ring=1 << 13, table=1 << 17,
+                              levels=64)
+    got = StreamedEngine(cfg, caps).check(init_override=start)
+    assert got.violation is not None
+    assert got.violation.invariant == "NaiveNoTwoLeaders"
+    trace = got.violation.trace
+    assert trace[0][0] is None and trace[0][1] == start
+    for (_l, prev), (_label, cur) in zip(trace, trace[1:]):
+        succs = [t for _i, t in interp.successors(prev, bounds,
+                                                  spec="election")]
+        assert cur in succs
+    assert not inv_mod.py_invariant("NaiveNoTwoLeaders")(
+        got.violation.state, bounds)
+
+
+def test_checkpoint_resume_bit_exact(tmp_path):
+    ck = str(tmp_path / "streamed.ckpt")
+
+    def eng():
+        e = StreamedEngine(CFG, CAPS, seg_chunks=8)
+        e.SEG_MAX = 8
+        return e
+
+    straight = eng().check()
+    res = eng().check(checkpoint=ck, checkpoint_every_s=0.0)
+    assert res.n_states == straight.n_states
+    resumed = eng().check(resume=ck)
+    assert resumed.n_states == straight.n_states
+    assert resumed.levels == straight.levels
+    assert resumed.n_transitions == straight.n_transitions
+    assert resumed.coverage == straight.coverage
+    assert resumed.violation is None
+
+    other = StreamedEngine(CFG, StreamedCapacities(
+        block=512, ring=4096, table=1 << 14, levels=64))
+    with pytest.raises(ValueError, match="checkpoint"):
+        other.check(resume=ck)
+
+
+def test_symmetry_composes():
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      symmetry=("Server",), chunk=32)
+    ref = refbfs.check(cfg)
+    got = StreamedEngine(cfg, CAPS).check()
+    assert got.n_states == ref.n_states == 1514
+    assert got.diameter == ref.diameter
+    assert got.coverage == ref.coverage
+
+
+def test_deadlock_detected():
+    cfg = CheckConfig(bounds=Bounds(n_servers=1, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=(), chunk=16,
+                      check_deadlock=True)
+    ref = refbfs.check(cfg)
+    caps = StreamedCapacities(block=64, ring=2048, table=1 << 12,
+                              levels=64)
+    got = StreamedEngine(cfg, caps).check()
+    assert ref.violation is not None and got.violation is not None
+    assert got.violation.invariant == ref.violation.invariant  # DEADLOCK
+    assert got.n_states == ref.n_states
