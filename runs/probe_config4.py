@@ -1,0 +1,52 @@
+"""Sizing probe for BASELINE config #4 (north star): full ``Next`` incl.
+Drop/Duplicate faults, 5 servers / 2 values, t2 l1 m2, SYMMETRY Server.
+
+Runs a deadline-bounded streamed-engine segment on the real chip and
+prints per-level growth + warm orbit rate — the measured inputs of the
+quantitative sizing memo (runs/northstar_sizing.md).  Usage:
+
+    python runs/probe_config4.py [deadline_seconds]
+"""
+
+import json
+import sys
+import time
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.streamed_engine import StreamedCapacities, StreamedEngine
+
+
+def main(deadline: float) -> None:
+    cfg = CheckConfig(
+        bounds=Bounds(n_servers=5, n_values=2, max_term=2, max_log=1,
+                      max_msgs=2, max_dup=1),
+        spec="full",
+        invariants=("NoTwoLeaders", "LogMatching", "CommittedWithinLog",
+                    "LeaderCompleteness"),
+        symmetry=("Server",), chunk=2048)
+    eng = StreamedEngine(cfg, StreamedCapacities(
+        block=1 << 20, ring=1 << 22, table=1 << 26, levels=128))
+    stats: list = []
+
+    def on_progress(d):
+        stats.append(d)
+        print(json.dumps(d), file=sys.stderr, flush=True)
+
+    t0 = time.monotonic()
+    r = eng.check(deadline_s=deadline, on_progress=on_progress)
+    print(json.dumps({
+        "config": "baseline#4 5s/2v full Next t2l1m2 SYMMETRY Server",
+        "orbits": r.n_states,
+        "levels": r.levels,
+        "complete": r.complete,
+        "violation": r.violation is not None,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "warm_orbits_per_sec": round(
+            (stats[-1]["n_states"] - stats[0]["n_states"])
+            / max(stats[-1]["wall_s"] - stats[0]["wall_s"], 1e-9), 1)
+        if len(stats) >= 2 else None,
+    }))
+
+
+if __name__ == "__main__":
+    main(float(sys.argv[1]) if len(sys.argv) > 1 else 120.0)
