@@ -251,3 +251,19 @@ def test_cli_init_next_stanzas(tmp_path):
         template.replace("SPECIFICATION Spec", "NEXT LiveNext"))
     code, _ = run_cli(str(tmp_path / "b.cfg"), "--engine", "ref", *tiny)
     assert code == cli.EXIT_ERROR
+
+
+def test_cli_streamed_and_pagedshard_engines(tmp_path):
+    """The two round-2 engines run end-to-end from the CLI with the
+    standard report and exit code."""
+    cfg = write_cfg(tmp_path / "e.cfg")
+    code, out = run_cli(cfg, "--engine", "streamed", "--spec", "election",
+                        "--max-term", "2", "--max-log", "0",
+                        "--max-msgs", "2", "--chunk", "64",
+                        "--cap", "65536", "--ring", "8192")
+    assert code == 0 and "3014 distinct states" in out
+    code, out = run_cli(cfg, "--engine", "pagedshard", "--spec",
+                        "election", "--max-term", "2", "--max-log", "0",
+                        "--max-msgs", "2", "--chunk", "64",
+                        "--cap", "65536", "--devices", "8")
+    assert code == 0 and "3014 distinct states" in out
