@@ -127,3 +127,24 @@ def test_deadlock_detected():
     assert ref.violation is not None and got.violation is not None
     assert got.violation.invariant == ref.violation.invariant  # DEADLOCK
     assert got.n_states == ref.n_states
+
+
+def test_faithful_mode_parity():
+    """Faithful mode (history variables as real fingerprinted state) on
+    the streamed engine: packed history rows survive the host round-trip
+    (store -> frontier re-upload) bit-exactly."""
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=1, max_msgs=2, history=True,
+                                    max_elections=4),
+                      spec="full",
+                      invariants=("NoTwoLeaders", "ElectionSafetyHist",
+                                  "AllLogsPrefixClosed"), chunk=512)
+    ref = refbfs.check(cfg)
+    assert (ref.n_states, ref.diameter) == (53398, 32)
+    caps = StreamedCapacities(block=1 << 13, ring=1 << 15, table=1 << 18,
+                              levels=64)
+    got = StreamedEngine(cfg, caps).check()
+    assert (got.n_states, got.diameter) == (ref.n_states, ref.diameter)
+    assert got.levels == ref.levels
+    assert got.coverage == ref.coverage
+    assert got.violation is None
