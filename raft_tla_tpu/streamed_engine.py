@@ -38,6 +38,7 @@ paged engine: host-store streams + device carry snapshot, digest-guarded.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from collections import Counter
 from typing import NamedTuple
@@ -285,16 +286,21 @@ class StreamedEngine:
         """Snapshots are taken at BLOCK boundaries only (the host loop's
         invariant): re-expansion on resume would double-count transition/
         coverage counters, so the resume point must be exactly a completed
-        block.  ``blocks_done`` = completed blocks of the frontier level."""
-        ckpt.stream_rows_out(path + ".rows", host.read, paged,
-                             self.schema.P)
+        block.  ``blocks_done`` = completed blocks of the frontier level.
+
+        Streams extend INCREMENTALLY (ckpt.stream_rows_append): the host
+        stores are append-only, so each snapshot writes only the suffix
+        since the previous one — full rewrites cost ~10 idle-device
+        minutes each at 10^8-orbit scale."""
+        ckpt.stream_rows_append(path + ".rows", host.read, paged,
+                                self.schema.P)
 
         def links_reader(start, n):
             par, lan = host.read_links(start, n)
             return np.stack([par, lan], axis=1)
 
-        ckpt.stream_rows_out(path + ".links", links_reader, paged, 2)
-        ckpt.stream_rows_out(path + ".con", constore.read, paged, 1)
+        ckpt.stream_rows_append(path + ".links", links_reader, paged, 2)
+        ckpt.stream_rows_append(path + ".con", constore.read, paged, 1)
         arrs = jax.device_get(carry)
         ckpt.atomic_savez(
             path,
@@ -349,9 +355,35 @@ class StreamedEngine:
                     levels=[1], wall_s=time.monotonic() - t0)
 
         B = self.config.chunk
+        # Incremental snapshots (save_checkpoint) extend the checkpoint
+        # path's stream files in place, trusting their existing prefix.
+        # That trust is only valid for rows THIS run verified:
+        # - fresh run: any streams at the checkpoint path are leftovers
+        #   of some other run — delete them (a digest on the npz alone
+        #   would not protect them);
+        # - resume from the same path: rows beyond the npz's ``paged``
+        #   were written by a later, superseded snapshot of a previous
+        #   process — trim to ``paged`` so they are re-written from this
+        #   run's own store, not assumed bit-identical.
+        if checkpoint:
+            if resume and os.path.abspath(resume) == \
+                    os.path.abspath(checkpoint):
+                pass                      # trimmed after load, below
+            else:
+                for suf in (".rows", ".links", ".con"):
+                    try:
+                        os.remove(checkpoint + suf)
+                    except FileNotFoundError:
+                        pass
         if resume:
             (carry, host, constore, paged, level_ends,
              blocks_done) = self.load_checkpoint(resume, (hi0, lo0))
+            if checkpoint and os.path.abspath(resume) == \
+                    os.path.abspath(checkpoint):
+                ckpt.trim_stream(checkpoint + ".rows", paged,
+                                 self.schema.P)
+                ckpt.trim_stream(checkpoint + ".links", paged, 2)
+                ckpt.trim_stream(checkpoint + ".con", paged, 1)
         else:
             carry = self._init_carry(np.uint32(hi0), np.uint32(lo0))
             host = native.make_store(self.schema.P)

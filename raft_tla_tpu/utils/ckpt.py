@@ -63,6 +63,8 @@ def atomic_savez(path: str, **arrays) -> None:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:      # file handle: savez adds no suffix
         np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())        # durable before it replaces the old
     os.replace(tmp, path)
 
 
@@ -89,7 +91,78 @@ def stream_rows_out(path: str, reader, n_rows: int, width: int) -> None:
             n = min(_STREAM_ROWS, n_rows - start)
             np.ascontiguousarray(reader(start, n), np.int32).tofile(f)
             start += n
+        # durability before the replace: os.replace of an unsynced file
+        # can otherwise destroy the last good snapshot AND lose the new
+        # one in a power cut
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+def stream_rows_append(path: str, reader, end: int, width: int) -> None:
+    """Extend an append-only row stream to ``end`` rows IN PLACE.
+
+    The engines' host stores are append-only with stable prefixes, so a
+    snapshot only ever needs to add the suffix since the previous one —
+    a full :func:`stream_rows_out` rewrite costs minutes of idle device
+    at 10^8-state scale (measured: the elect5 campaign's rewriting
+    snapshots took ~10 min each at 50-90M orbits).
+
+    Crash safety, by write order: the file is truncated to the header's
+    row count (dropping any garbage from a previously torn append), the
+    new rows are appended and fsynced, and the header's count is updated
+    LAST — a crash at any point leaves a consistent prefix no shorter
+    than the last completed snapshot, which is exactly the contract
+    :func:`stream_rows_in` already relies on.  A width change or a
+    missing file falls back to the full atomic rewrite.
+    """
+    if not os.path.exists(path):
+        return stream_rows_out(path, reader, end, width)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        hdr = np.fromfile(f, np.int64, 2)
+        if (hdr.shape[0] != 2 or int(hdr[1]) != width
+                or size < 16 + int(hdr[0]) * width * 4):
+            # width change, or a header vouching for more bytes than the
+            # file holds (torn full write): nothing here is trustworthy —
+            # full rewrite.  (truncate() would silently ZERO-FILL a short
+            # file, so the size check must come first.)
+            f.close()
+            return stream_rows_out(path, reader, end, width)
+        # the valid prefix: rows the header vouches for, capped at the
+        # target (a longer stream can outlive an older metadata npz —
+        # see stream_rows_in — and its prefix is still bit-identical)
+        start = min(int(hdr[0]), end)
+        f.truncate(16 + start * width * 4)
+        f.seek(0, os.SEEK_END)
+        while start < end:
+            n = min(_STREAM_ROWS, end - start)
+            np.ascontiguousarray(reader(start, n), np.int32).tofile(f)
+            start += n
+        f.flush()
+        os.fsync(f.fileno())
+        f.seek(0)
+        np.array([end, width], np.int64).tofile(f)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def trim_stream(path: str, n_rows: int, width: int) -> None:
+    """Cap an append-only stream's trusted prefix at ``n_rows`` (resume
+    hygiene: rows beyond the restored metadata's count came from a
+    superseded snapshot and must be re-written, not assumed identical)."""
+    if not os.path.exists(path):
+        return
+    with open(path, "r+b") as f:
+        hdr = np.fromfile(f, np.int64, 2)
+        if hdr.shape[0] != 2 or int(hdr[1]) != width \
+                or int(hdr[0]) <= n_rows:
+            return
+        f.truncate(16 + n_rows * width * 4)
+        f.seek(0)
+        np.array([n_rows, width], np.int64).tofile(f)
+        f.flush()
+        os.fsync(f.fileno())
 
 
 def stream_rows_in(path: str, writer, limit: int,

@@ -140,3 +140,94 @@ def test_digest_covers_deadlock_toggle():
     on = ckpt.config_digest(dataclasses.replace(CFG, check_deadlock=True),
                             CAPS, (1, 2))
     assert base != on
+
+
+def test_stream_rows_append_incremental(tmp_path):
+    """Append-only snapshot streams: extending in place must be byte-
+    equivalent to a full rewrite, survive a torn append (garbage past the
+    header count), cap at an older header, and reject nothing silently."""
+    from raft_tla_tpu.utils import ckpt
+
+    data = np.arange(20 * 3, dtype=np.int32).reshape(20, 3)
+
+    def reader(start, n):
+        return data[start:start + n]
+
+    p = str(tmp_path / "s.rows")
+    # fresh append == full write
+    ckpt.stream_rows_append(p, reader, 8, 3)
+    got = []
+    ckpt.stream_rows_in(p, got.append, 8, expect_width=3)
+    assert np.array_equal(np.concatenate(got), data[:8])
+    # incremental extension
+    ckpt.stream_rows_append(p, reader, 15, 3)
+    got = []
+    ckpt.stream_rows_in(p, got.append, 15, expect_width=3)
+    assert np.array_equal(np.concatenate(got), data[:15])
+    # torn append: garbage beyond the header count is dropped on the
+    # next snapshot (truncate-to-header before appending)
+    with open(p, "ab") as f:
+        np.full((7,), -999, np.int32).tofile(f)
+    ckpt.stream_rows_append(p, reader, 18, 3)
+    got = []
+    ckpt.stream_rows_in(p, got.append, 18, expect_width=3)
+    assert np.array_equal(np.concatenate(got), data[:18])
+    # width change falls back to a full rewrite
+    data2 = np.arange(6 * 4, dtype=np.int32).reshape(6, 4)
+    ckpt.stream_rows_append(p, lambda s, n: data2[s:s + n], 6, 4)
+    got = []
+    ckpt.stream_rows_in(p, got.append, 6, expect_width=4)
+    assert np.array_equal(np.concatenate(got), data2)
+
+
+def test_stream_append_shrink_and_stale_protection(tmp_path):
+    """The shrink path (end below the current header) and the engine's
+    stale-stream hygiene: a fresh run pointed at an existing checkpoint
+    path must not inherit another run's stream prefix."""
+    from raft_tla_tpu.utils import ckpt
+    data = np.arange(20 * 3, dtype=np.int32).reshape(20, 3)
+
+    def reader(start, n):
+        return data[start:start + n]
+
+    p = str(tmp_path / "s.rows")
+    ckpt.stream_rows_append(p, reader, 15, 3)
+    # shrink: trusted prefix capped below the header (resume from an
+    # older npz), then re-extended — rows must be the reader's, readable
+    ckpt.trim_stream(p, 10, 3)
+    got = []
+    ckpt.stream_rows_in(p, got.append, 10, expect_width=3)
+    assert np.array_equal(np.concatenate(got), data[:10])
+    ckpt.stream_rows_append(p, reader, 12, 3)
+    got = []
+    ckpt.stream_rows_in(p, got.append, 12, expect_width=3)
+    assert np.array_equal(np.concatenate(got), data[:12])
+    # append with end below header: file caps at end
+    ckpt.stream_rows_append(p, reader, 5, 3)
+    got = []
+    ckpt.stream_rows_in(p, got.append, 5, expect_width=3)
+    assert np.array_equal(np.concatenate(got), data[:5])
+
+    # a FRESH StreamedEngine run pointed at a path holding another run's
+    # streams must rewrite them from scratch (not append-reuse)
+    from raft_tla_tpu.config import Bounds, CheckConfig
+    from raft_tla_tpu.streamed_engine import (StreamedCapacities,
+                                              StreamedEngine)
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=32)
+    caps = StreamedCapacities(block=256, ring=4096, table=1 << 14,
+                              levels=64)
+    ck = str(tmp_path / "fresh.ckpt")
+    # plant a bogus stream at the checkpoint path
+    ckpt.stream_rows_out(ck + ".rows", lambda s, n: np.full(
+        (n, StreamedEngine(cfg, caps).schema.P), -7, np.int32), 100,
+        StreamedEngine(cfg, caps).schema.P)
+    eng = StreamedEngine(cfg, caps, seg_chunks=8)
+    eng.SEG_MAX = 8
+    straight = eng.check(checkpoint=ck, checkpoint_every_s=0.0)
+    eng2 = StreamedEngine(cfg, caps, seg_chunks=8)
+    resumed = eng2.check(resume=ck)
+    assert resumed.n_states == straight.n_states == 3014
+    assert resumed.levels == straight.levels
