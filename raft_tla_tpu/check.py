@@ -42,13 +42,16 @@ def build_argparser() -> argparse.ArgumentParser:
                    choices=("full", "election", "replication"),
                    help="Next-disjunct subset (default: full raft.tla:454-465)")
     p.add_argument("--engine", default="device",
-                   choices=("device", "paged", "streamed", "shard",
+                   choices=("device", "paged", "streamed", "ddd", "shard",
                             "pagedshard", "host", "ref"),
                    help="device: search resident in HBM; paged: HBM ring + "
                         "native host store (capacity bounded by host RAM); "
                         "streamed: host-streamed frontier (no live-window "
                         "ceiling — for spaces whose BFS levels outgrow any "
-                        "ring); shard: multi-chip mesh; pagedshard: mesh "
+                        "ring); ddd: delayed duplicate detection — exact "
+                        "dedup on the host, no device fingerprint-table "
+                        "ceiling (for spaces past ~2^28 distinct states); "
+                        "shard: multi-chip mesh; pagedshard: mesh "
                         "whose per-device stores page to host RAM; host: "
                         "per-chunk jit; ref: pure-Python oracle")
     p.add_argument("--max-term", type=int, default=3,
@@ -303,6 +306,18 @@ def _run(args, config):
                          checkpoint=args.checkpoint,
                          checkpoint_every_s=args.checkpoint_every,
                          resume=args.resume)
+    if args.engine == "ddd":
+        from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+        # the filter table is a traffic optimization, not a capacity
+        # bound — size it to the expected state count, capped at the
+        # 2 GiB-buffer limit the exact tables live under
+        table = 1 << max(10, min(28, (2 * args.cap - 1).bit_length()))
+        eng = DDDEngine(config, DDDCapacities(
+            block=1 << 20, table=table, levels=args.levels))
+        return eng.check(on_progress=_stats_cb(args),
+                         checkpoint=args.checkpoint,
+                         checkpoint_every_s=args.checkpoint_every,
+                         resume=args.resume)
     if args.engine == "shard":
         from raft_tla_tpu.parallel.shard_engine import (
             ShardCapacities, ShardEngine, make_mesh)
@@ -345,7 +360,8 @@ def _run(args, config):
 def main(argv=None) -> int:
     p = build_argparser()
     args = p.parse_args(argv)
-    _DEVICE_ENGINES = ("device", "paged", "streamed", "shard", "pagedshard")
+    _DEVICE_ENGINES = ("device", "paged", "streamed", "ddd", "shard",
+                       "pagedshard")
     if (args.checkpoint or args.resume) and \
             args.engine not in _DEVICE_ENGINES:
         p.error(f"--checkpoint/--resume require a device-class engine "

@@ -1,0 +1,605 @@
+"""Delayed-duplicate-detection engine — exact dedup on the host (paged v3).
+
+Every prior device engine keeps the EXACT fingerprint set in HBM, which
+caps distinct-state capacity at ~2^28 slots (2 GiB single-buffer limit;
+the elect5 campaign measured probing degrade as load crossed 0.48 near
+130M orbits — RESULTS.md "capacity findings").  This engine removes the
+device table from the correctness path entirely, the external-memory
+regime TLC itself uses for its `states/` fingerprint set
+(`/root/reference/.gitignore:2`):
+
+- **Device: expand + fingerprint only.**  The per-chunk program expands a
+  slice of the frontier block, fingerprints the candidates, and pushes a
+  *compacted* candidate stream (key, packed row, parent, lane, constraint
+  flag) to the host.  The only device state is a **lossy filter table**:
+  a bucketized fingerprint cache probed in one gather, inserting with
+  overwrite-on-full-bucket instead of FAIL_PROBE.  A filter hit proves
+  the key was already streamed (inserts happen only for streamed
+  candidates), so hits are dropped on device — that filters the heavy
+  recent-duplicate traffic cheaply.  Misses (true new states + evicted
+  re-sights) stream to the host.  The filter affects traffic volume only,
+  never the verdict: resume even starts it EMPTY.
+- **Host: exact dedup in first-occurrence stream order.**  Candidates
+  buffer in a pending list; each flush sorts them, keeps each key's first
+  occurrence, anti-joins against the sorted master key array
+  (`utils/keyset.MasterKeys`), appends the genuinely-new states to the
+  native store in stream order, and merges their keys into the master.
+  Because the table engines also admit each state at its first occurrence
+  in stream order, discovery order — counts, levels, per-action coverage,
+  traces — is byte-identical to the oracle and every other engine (the
+  parity suite asserts it, including under forced filter eviction).
+- **Level-synchronous BFS** keeps counts exact: new states join the next
+  level only (frontier blocks stream host→device as in streamed_engine).
+
+Capacity: master keys 8 B/state + packed rows in host RAM (~10^9 states
+on this host), no device table in the correctness path — the designed
+fix for the elect5 2^28 ceiling (RESULTS.md, runs/northstar_sizing.md).
+
+Violation semantics match refbfs exactly: the candidate stream is
+truncated ON DEVICE at the first violating candidate (kept inclusively)
+or the first deadlocked row (its successors excluded), so `n_states` and
+`n_transitions` stop where the oracle's do.  A violating candidate is
+always genuinely new — a previously-seen state with a failing invariant
+would have stopped the run at ITS first occurrence — so after a forced
+flush the violator is the last appended state (asserted by key).
+
+Checkpoints are fully incremental: rows/links/constraints stream as in
+streamed_engine, and the master keys are checkpointed as their
+*discovery-order append log* (a width-2 int32 native store) — sorted
+back into the master on resume.  Snapshots land at block boundaries with
+an empty pending buffer, so resume never re-expands or double-counts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import time
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import CheckConfig
+from raft_tla_tpu.device_engine import (
+    _EMPTY, BUCKET, FAIL_INDEX, FAIL_LEVEL, FAIL_WIDTH, aggregate_coverage,
+    decode_fail)
+from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
+from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
+from raft_tla_tpu.ops import bitpack
+from raft_tla_tpu.ops import kernels
+from raft_tla_tpu.ops import state as st
+from raft_tla_tpu.ops import symmetry as sym_mod
+from raft_tla_tpu.utils import ckpt
+from raft_tla_tpu.utils import keyset
+from raft_tla_tpu.utils import native
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+# int32 discovery-index headroom: parents/links are int32 on the host
+# store; abort loudly long before they could wrap (SURVEY §4.5)
+_IDX_CEIL = (1 << 31) - (1 << 24)
+
+
+@dataclasses.dataclass(frozen=True)
+class DDDCapacities:
+    """Static shapes.  ``block``: frontier upload granularity; ``table``:
+    lossy filter slots (traffic optimization only — NOT a state-count
+    ceiling); ``flush``: pending candidates per host dedup pass;
+    ``levels``: host-side BFS-depth bound."""
+
+    block: int = 1 << 20
+    table: int = 1 << 26
+    flush: int = 1 << 23
+    levels: int = 1 << 12
+
+    def __post_init__(self):
+        for nm in ("block", "table"):
+            v = getattr(self, nm)
+            if v & (v - 1):
+                raise ValueError(f"{nm}={v} must be a power of two")
+        if self.table < BUCKET:
+            raise ValueError(
+                f"table={self.table} must be >= one bucket ({BUCKET})")
+
+
+class ChunkOut(NamedTuple):
+    tbl_hi: jax.Array     # [TB, BUCKET] lossy filter (donated through)
+    tbl_lo: jax.Array
+    okey_hi: jax.Array    # [N] compacted candidate stream ---------------
+    okey_lo: jax.Array
+    orows: jax.Array      # [N, P] bit-packed successor rows
+    opar: jax.Array       # [N] parent discovery index
+    olane: jax.Array      # [N] action lane
+    ocon: jax.Array       # [N] constraint flag ---------------------------
+    n_stream: jax.Array   # compacted count
+    n_valid: jax.Array    # transitions counted (truncated at violation)
+    fail: jax.Array       # FAIL_WIDTH bit
+    viol_kind: jax.Array  # 0 none / 1 invariant / 2 deadlock
+    viol_inv: jax.Array   # invariant index (kind 1)
+    dead_g: jax.Array     # kind 2: dead state's discovery index
+
+
+def _filter_insert(tbl_hi, tbl_lo, key_hi, key_lo, active):
+    """Lossy one-gather filter probe + insert.
+
+    Returns ``(tbl_hi, tbl_lo, stream)`` where ``stream[c]`` is True iff
+    candidate c is active, is the first active candidate carrying its key
+    in this batch (same two-sort first-occurrence pass as
+    device_engine._dedup_insert stage 1), and its key is NOT in the
+    filter.  Streamed keys are inserted: first empty slot, else overwrite
+    the key-hashed slot — eviction only widens the stream (the host
+    dedups exactly), it never drops a state.
+    """
+    BA = key_hi.shape[0]
+    TB, Sb = tbl_hi.shape
+    bmask = jnp.uint32(TB - 1)
+    skh = jnp.where(active, key_hi, _EMPTY)
+    skl = jnp.where(active, key_lo, _EMPTY)
+    perm = jnp.lexsort((skl, skh))       # stable: ties keep stream order
+    ph, pl, pa = key_hi[perm], key_lo[perm], active[perm]
+    same_as_prev = jnp.concatenate([
+        jnp.zeros((1,), bool),
+        (ph[1:] == ph[:-1]) & (pl[1:] == pl[:-1]) & pa[1:] & pa[:-1]])
+    first_of_key = jnp.zeros((BA,), bool).at[perm].set(~same_as_prev)
+    probe = active & first_of_key
+
+    bidx = (key_lo & bmask).astype(I32)
+    row_hi, row_lo = tbl_hi[bidx], tbl_lo[bidx]          # [BA, Sb] gather
+    seen = jnp.any((row_hi == key_hi[:, None])
+                   & (row_lo == key_lo[:, None]), axis=1)
+    stream = probe & ~seen
+    slot_empty = (row_hi == _EMPTY) & (row_lo == _EMPTY)
+    has_empty = jnp.any(slot_empty, axis=1)
+    evict = (key_hi % jnp.uint32(Sb)).astype(I32)
+    wslot = jnp.where(has_empty, jnp.argmax(slot_empty, axis=1), evict)
+    wb = jnp.where(stream, bidx, TB)
+    tbl_hi = tbl_hi.at[wb, wslot].set(key_hi, mode="drop")
+    tbl_lo = tbl_lo.at[wb, wslot].set(key_lo, mode="drop")
+    return tbl_hi, tbl_lo, stream
+
+
+def _build_chunk(config: CheckConfig, caps: DDDCapacities, A: int, W: int,
+                 schema: bitpack.BitSchema):
+    B = config.chunk
+    N = B * A
+    n_inv = len(config.invariants)
+    step = kernels.build_step(config.bounds, config.spec,
+                              tuple(config.invariants), config.symmetry)
+    BIG = jnp.int32(np.iinfo(np.int32).max)
+
+    def chunk(tbl_hi, tbl_lo, fbuf, fcon, block_start, block_rows, c):
+        r0 = c * B
+        rows_b = r0 + jnp.arange(B, dtype=I32)
+        row_act = rows_b < block_rows
+        bidx = jnp.minimum(rows_b, caps.block - 1)
+        vecs = schema.unpack(fbuf[bidx], jnp)
+        out = step(vecs)
+        valid = out["valid"] & row_act[:, None] & fcon[bidx][:, None]
+        fvalid = valid.reshape(-1)
+        iota = jnp.arange(N, dtype=I32)
+
+        # refbfs-exact truncation: first invariant violation (violator
+        # kept) vs first dead row (its and later rows' candidates cut),
+        # ordered the way streamed_engine orders them (flat candidate
+        # position vs drow * A)
+        inv_bad = fvalid & jnp.any(
+            ~out["inv_ok"].reshape(N, n_inv), axis=-1) if n_inv \
+            else jnp.zeros((N,), bool)
+        first_inv = jnp.min(jnp.where(inv_bad, iota, BIG))
+        if config.check_deadlock:
+            dead = row_act & fcon[bidx] & ~jnp.any(out["valid"], axis=1)
+            drow = jnp.min(jnp.where(dead, jnp.arange(B, dtype=I32), BIG))
+            dpos = jnp.where(drow < BIG // A, drow * A, BIG)
+        else:
+            drow = BIG
+            dpos = BIG
+        use_dead = dpos < first_inv
+        has_inv = (first_inv < BIG) & ~use_dead
+        cut_incl = jnp.where(use_dead, dpos - 1,
+                             jnp.where(first_inv < BIG, first_inv, BIG))
+        keep = iota <= cut_incl
+        kvalid = fvalid & keep
+        n_valid = jnp.sum(kvalid.astype(I32))
+        fail = jnp.any(kvalid & out["overflow"].reshape(-1)).astype(I32) \
+            * FAIL_WIDTH
+
+        fhi = out["fp_hi"].reshape(-1)
+        flo = out["fp_lo"].reshape(-1)
+        tbl_hi, tbl_lo, stream = _filter_insert(tbl_hi, tbl_lo, fhi, flo,
+                                                kvalid)
+        pos = jnp.cumsum(stream.astype(I32)) - 1
+        n_stream = jnp.sum(stream.astype(I32))
+        sl = jnp.where(stream, pos, N)
+        svecs = schema.pack(out["svecs"].reshape(N, W), jnp)
+        okey_hi = jnp.zeros((N,), U32).at[sl].set(fhi, mode="drop")
+        okey_lo = jnp.zeros((N,), U32).at[sl].set(flo, mode="drop")
+        orows = jnp.zeros((N, schema.P), I32).at[sl].set(svecs, mode="drop")
+        opar = jnp.zeros((N,), I32).at[sl].set(
+            block_start + r0 + iota // A, mode="drop")
+        olane = jnp.zeros((N,), I32).at[sl].set(iota % A, mode="drop")
+        ocon = jnp.zeros((N,), bool).at[sl].set(
+            out["con_ok"].reshape(-1), mode="drop")
+
+        viol_kind = jnp.where(use_dead, 2, jnp.where(has_inv, 1, 0))
+        viol_inv = jnp.argmax(~out["inv_ok"].reshape(N, n_inv)[
+            jnp.minimum(first_inv, N - 1)]) if n_inv else jnp.int32(0)
+        dead_g = block_start + r0 + jnp.minimum(drow, B - 1)
+        return ChunkOut(tbl_hi, tbl_lo, okey_hi, okey_lo, orows, opar,
+                        olane, ocon, n_stream, n_valid, fail,
+                        viol_kind.astype(I32), viol_inv.astype(I32),
+                        dead_g)
+
+    return chunk
+
+
+@functools.lru_cache(maxsize=64)
+def _slicer(k: int):
+    """Jitted prefix-slice so d2h transfers only ~n_stream rows; cached
+    per padded size (sizes are rounded to powers of two, so at most
+    log2(N) programs compile per engine)."""
+    return jax.jit(lambda *arrs: tuple(a[:k] for a in arrs))
+
+
+class DDDEngine:
+    """Exhaustive checker whose exact dedup lives on the host — distinct-
+    state capacity is host RAM, with no device fingerprint table in the
+    correctness path."""
+
+    def __init__(self, config: CheckConfig,
+                 caps: DDDCapacities | None = None):
+        self.config = config
+        self.bounds = config.bounds
+        self.lay = st.Layout.of(self.bounds)
+        self.table = S.action_table(self.bounds, config.spec)
+        self.A = len(self.table)
+        self.caps = caps or DDDCapacities()
+        if self.caps.block < config.chunk:
+            raise ValueError("block must be >= chunk")
+        self.schema = bitpack.BitSchema(self.bounds)
+        self._chunk = jax.jit(
+            _build_chunk(config, self.caps, self.A, self.lay.width,
+                         self.schema),
+            donate_argnums=(0, 1))
+
+    def _fresh_filter(self):
+        TB = self.caps.table // BUCKET
+        return (jnp.full((TB, BUCKET), _EMPTY, U32),
+                jnp.full((TB, BUCKET), _EMPTY, U32))
+
+    # -- host dedup -----------------------------------------------------
+
+    def _flush(self, pend, master, host, constore, keystore, cov) -> int:
+        """Exact-dedup the pending candidate stream; append the new
+        states in first-occurrence order.  Returns the number appended."""
+        if not pend["keys"]:
+            return 0
+        keys = np.concatenate(pend["keys"])
+        new_idx = master.dedup(keys)
+        n_new = int(new_idx.size)
+        if n_new:
+            rows = np.concatenate(pend["rows"])[new_idx]
+            par = np.concatenate(pend["par"])[new_idx]
+            lane = np.concatenate(pend["lane"])[new_idx]
+            con = np.concatenate(pend["con"])[new_idx]
+            host.append(rows)
+            host.append_links(par, lane)
+            constore.append(con.astype(np.int32)[:, None])
+            nk = keys[new_idx]
+            keystore.append(np.stack(
+                [(nk & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                 (nk >> np.uint64(32)).astype(np.uint32)],
+                axis=1).view(np.int32))
+            cov += np.bincount(lane, minlength=self.A)
+        for lst in pend.values():
+            lst.clear()
+        return n_new
+
+    # -- checkpoint / resume --------------------------------------------
+
+    def save_checkpoint(self, path: str, host, constore, keystore,
+                        n_states: int, n_trans: int, cov, level_ends,
+                        blocks_done: int, init_key) -> None:
+        """Block-boundary snapshots with an empty pending buffer; every
+        stream (rows/links/constraints/keys) extends incrementally."""
+        ckpt.stream_rows_append(path + ".rows", host.read, n_states,
+                                self.schema.P)
+
+        def links_reader(start, n):
+            par, lan = host.read_links(start, n)
+            return np.stack([par, lan], axis=1)
+
+        ckpt.stream_rows_append(path + ".links", links_reader, n_states, 2)
+        ckpt.stream_rows_append(path + ".con", constore.read, n_states, 1)
+        ckpt.stream_rows_append(path + ".keys", keystore.read, n_states, 2)
+        ckpt.atomic_savez(
+            path,
+            n_states=np.int64(n_states),
+            n_trans=np.uint64(n_trans),
+            cov=np.asarray(cov, np.int64),
+            level_ends=np.asarray(level_ends, np.int64),
+            blocks_done=np.int64(blocks_done),
+            config_digest=np.uint64(
+                ckpt.config_digest(self.config, self.caps, init_key)))
+
+    def load_checkpoint(self, path: str, init_key):
+        with ckpt.load_npz_checked(
+                path, ckpt.config_digest(self.config, self.caps,
+                                         init_key)) as z:
+            n_states = int(z["n_states"])
+            n_trans = int(z["n_trans"])
+            cov = np.asarray(z["cov"], np.int64).copy()
+            level_ends = [int(x) for x in z["level_ends"]]
+            blocks_done = int(z["blocks_done"])
+        host = native.make_store(self.schema.P)
+        constore = native.make_store(1)
+        keystore = native.make_store(2)
+        ckpt.stream_rows_in(path + ".rows", host.append, n_states,
+                            expect_width=self.schema.P)
+        ckpt.stream_rows_in(
+            path + ".links",
+            lambda blk: host.append_links(blk[:, 0], blk[:, 1]), n_states,
+            expect_width=2)
+        ckpt.stream_rows_in(path + ".con", constore.append, n_states,
+                            expect_width=1)
+        ckpt.stream_rows_in(path + ".keys", keystore.append, n_states,
+                            expect_width=2)
+        kw = keystore.read(0, n_states).view(np.uint32)
+        keys = keyset.pack_keys(kw[:, 1], kw[:, 0])
+        master = keyset.MasterKeys(np.sort(keys))
+        if len(master) != n_states:
+            raise ValueError(
+                f"checkpoint key log has {len(master)} distinct keys for "
+                f"{n_states} states — stream corrupt")
+        return (host, constore, keystore, master, n_states, n_trans, cov,
+                level_ends, blocks_done)
+
+    # -- main loop ------------------------------------------------------
+
+    def check(self, init_override: interp.PyState | None = None,
+              on_progress=None, checkpoint: str | None = None,
+              checkpoint_every_s: float = 600.0,
+              resume: str | None = None,
+              deadline_s: float | None = None) -> EngineResult:
+        t0 = time.monotonic()
+        bounds = self.bounds
+        init_py = init_override if init_override is not None \
+            else interp.init_state(bounds)
+        init_vec = interp.to_vec(init_py, bounds)
+        hi0, lo0 = sym_mod.init_fingerprint(self.config, init_py, init_vec)
+
+        for nm in self.config.invariants:
+            if not inv_mod.py_invariant(nm)(init_py, bounds):
+                from collections import Counter
+                return EngineResult(
+                    n_states=1, diameter=0, n_transitions=0,
+                    coverage=Counter(),
+                    violation=Violation(nm, init_py, [(None, init_py)]),
+                    levels=[1], wall_s=time.monotonic() - t0)
+
+        B = self.config.chunk
+        N = B * self.A
+        # fresh run: any stream files at the checkpoint path belong to
+        # some other run — remove before incremental appends trust them
+        # (same contract as streamed_engine.check)
+        _SUFFIXES = (".rows", ".links", ".con", ".keys")
+        if checkpoint and not (resume and os.path.abspath(resume)
+                               == os.path.abspath(checkpoint)):
+            for suf in _SUFFIXES:
+                try:
+                    os.remove(checkpoint + suf)
+                except FileNotFoundError:
+                    pass
+        if resume:
+            (host, constore, keystore, master, n_states, n_trans, cov,
+             level_ends, blocks_done) = self.load_checkpoint(
+                resume, (hi0, lo0))
+            if checkpoint and os.path.abspath(resume) == \
+                    os.path.abspath(checkpoint):
+                for suf, w in ((".rows", self.schema.P), (".links", 2),
+                               (".con", 1), (".keys", 2)):
+                    ckpt.trim_stream(checkpoint + suf, n_states, w)
+        else:
+            host = native.make_store(self.schema.P)
+            constore = native.make_store(1)
+            keystore = native.make_store(2)
+            master = keyset.MasterKeys()
+            master.seed(int(keyset.pack_keys(
+                np.uint32(hi0)[None], np.uint32(lo0)[None])[0]))
+            init_packed = self.schema.pack(
+                np.asarray(init_vec, np.int32), np)
+            host.append(init_packed[None, :])
+            host.append_links(np.asarray([-1], np.int32),
+                              np.asarray([-1], np.int32))
+            con0 = interp.constraint_ok(init_py, bounds)
+            constore.append(np.asarray([[con0]], np.int32))
+            keystore.append(np.asarray(
+                [[np.uint32(lo0), np.uint32(hi0)]],
+                np.uint32).view(np.int32))
+            n_states = 1
+            n_trans = 0
+            cov = np.zeros(self.A, np.int64)
+            level_ends = [1]
+            blocks_done = 0
+
+        tbl_hi, tbl_lo = self._fresh_filter()   # filter ≠ correctness:
+        pend = {"keys": [], "rows": [], "par": [],  # resume starts empty
+                "lane": [], "con": []}
+        Fcap = self.caps.block
+        viol = None          # (kind, inv_idx, dead_g) once detected
+        viol_key = None
+        fail = 0
+        complete = True
+        stopped = False
+        t_warm = None
+        last_ckpt = time.monotonic()
+
+        def progress():
+            if on_progress is None:
+                return
+            wall = time.monotonic() - t0
+            on_progress({
+                "wall_s": round(wall, 3),
+                "n_states": n_states + sum(
+                    len(k) for k in pend["keys"]),   # upper bound
+                "level": len(level_ends),
+                "n_transitions": n_trans,
+                "dedup_hit_rate": round(
+                    max(0.0, 1.0 - n_states / max(n_trans, 1)), 4),
+                "states_per_sec": round(n_states / max(wall, 1e-9), 1),
+                "coverage": dict(aggregate_coverage(self.table, cov)),
+            })
+
+        while not stopped:
+            lvl_lo = level_ends[-2] if len(level_ends) > 1 else 0
+            lvl_hi = level_ends[-1]
+            for b_start in range(lvl_lo + blocks_done * Fcap, lvl_hi,
+                                 Fcap):
+                b_rows = min(Fcap, lvl_hi - b_start)
+                blk = host.read(b_start, b_rows)
+                con = constore.read(b_start, b_rows)[:, 0].astype(bool)
+                if b_rows < Fcap:
+                    blk = np.concatenate([blk, np.zeros(
+                        (Fcap - b_rows, self.schema.P), np.int32)])
+                    con = np.concatenate(
+                        [con, np.zeros((Fcap - b_rows,), bool)])
+                fbuf = jnp.asarray(blk)
+                fcon = jnp.asarray(con)
+                n_chunks = (b_rows + B - 1) // B
+                for c in range(n_chunks):
+                    if (deadline_s is not None and t_warm is not None
+                            and time.monotonic() - t_warm > deadline_s):
+                        complete = False
+                        stopped = True
+                        break
+                    o = self._chunk(tbl_hi, tbl_lo, fbuf, fcon,
+                                    jnp.int32(b_start), jnp.int32(b_rows),
+                                    jnp.int32(c))
+                    tbl_hi, tbl_lo = o.tbl_hi, o.tbl_lo
+                    (ns, nv, fl, vk) = map(int, jax.device_get(
+                        (o.n_stream, o.n_valid, o.fail, o.viol_kind)))
+                    n_trans += nv
+                    fail |= fl
+                    if ns:
+                        k = max(1024, 1 << (ns - 1).bit_length())
+                        kh, kl, rws, par, lan, cn = jax.device_get(
+                            _slicer(min(k, N))(
+                                o.okey_hi, o.okey_lo, o.orows, o.opar,
+                                o.olane, o.ocon))
+                        pend["keys"].append(
+                            keyset.pack_keys(kh[:ns], kl[:ns]))
+                        pend["rows"].append(rws[:ns])
+                        pend["par"].append(par[:ns])
+                        pend["lane"].append(lan[:ns])
+                        pend["con"].append(cn[:ns])
+                    if t_warm is None:
+                        t_warm = time.monotonic()
+                    if vk or fail:
+                        if vk:
+                            vi, dg = map(int, jax.device_get(
+                                (o.viol_inv, o.dead_g)))
+                            viol = (vk, vi, dg)
+                            if vk == 1:
+                                # truncation makes the violator the last
+                                # streamed candidate; remember its key to
+                                # assert the flushed identity below
+                                viol_key = pend["keys"][-1][-1]
+                        stopped = True
+                        break
+                    if sum(len(x) for x in pend["keys"]) >= \
+                            self.caps.flush:
+                        n_states += self._flush(pend, master, host,
+                                                constore, keystore, cov)
+                        if n_states > _IDX_CEIL:
+                            fail = FAIL_INDEX
+                            stopped = True
+                            break
+                        progress()
+                if stopped:
+                    break
+                blocks_done += 1
+                if checkpoint and (time.monotonic() - last_ckpt
+                                   >= checkpoint_every_s):
+                    n_states += self._flush(pend, master, host, constore,
+                                            keystore, cov)
+                    self.save_checkpoint(checkpoint, host, constore,
+                                         keystore, n_states, n_trans,
+                                         cov, level_ends, blocks_done,
+                                         (hi0, lo0))
+                    last_ckpt = time.monotonic()
+            if stopped:
+                break
+            blocks_done = 0
+            n_states += self._flush(pend, master, host, constore,
+                                    keystore, cov)
+            progress()
+            if n_states > _IDX_CEIL:
+                fail = FAIL_INDEX
+                break
+            if n_states == level_ends[-1]:       # no new states: done
+                break
+            level_ends.append(n_states)
+            if len(level_ends) > self.caps.levels:
+                raise RuntimeError(
+                    f"DDD search aborted: {decode_fail(FAIL_LEVEL)} "
+                    f"(caps={self.caps}) — grow DDDCapacities and rerun")
+
+        n_states += self._flush(pend, master, host, constore, keystore,
+                                cov)
+        if fail:
+            raise RuntimeError(
+                f"DDD search aborted: {decode_fail(fail)} "
+                f"(caps={self.caps}) — grow DDDCapacities and rerun")
+
+        violation = None
+        if viol is not None:
+            kind, vi, dead_g = viol
+            if kind == 1:
+                viol_g = n_states - 1    # the violator is always new and
+                n_inv = len(self.config.invariants)   # last in the flush
+                inv_name = self.config.invariants[min(vi, n_inv - 1)]
+                kw = keystore.read(viol_g, 1).view(np.uint32)
+                got_key = int(keyset.pack_keys(kw[:, 1], kw[:, 0])[0])
+                if got_key != int(viol_key):
+                    raise RuntimeError(
+                        "DDD violator identity mismatch after flush — "
+                        "fingerprint collision or dedup-order bug")
+            else:
+                viol_g = dead_g
+                inv_name = DEADLOCK
+            chain_idx = host.trace_chain(viol_g)
+            chain = []
+            for k, g in enumerate(chain_idx):
+                row = self.schema.unpack(host.read(int(g), 1)[0], np)
+                _, lane_g = host.read_links(int(g), 1)
+                py = interp.from_struct(st.unpack(row, self.lay, np),
+                                        self.bounds)
+                label = self.table[int(lane_g[0])].label() if k > 0 \
+                    else None
+                chain.append((label, py))
+            violation = Violation(invariant=inv_name, state=chain[-1][1],
+                                  trace=chain)
+
+        levels_arr = [level_ends[0]] + [
+            level_ends[k] - level_ends[k - 1]
+            for k in range(1, len(level_ends))]
+        tail = n_states - level_ends[-1]
+        if tail > 0:                 # partial final level (stopped run)
+            levels_arr.append(tail)
+        coverage = aggregate_coverage(self.table, cov)
+        host.close()
+        constore.close()
+        keystore.close()
+        return EngineResult(
+            n_states=n_states, diameter=len(levels_arr) - 1,
+            n_transitions=n_trans, coverage=coverage,
+            violation=violation, levels=levels_arr,
+            wall_s=time.monotonic() - t0, complete=complete)
+
+
+def check(config: CheckConfig, caps: DDDCapacities | None = None,
+          **kw) -> EngineResult:
+    return DDDEngine(config, caps).check(**kw)

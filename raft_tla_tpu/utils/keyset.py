@@ -1,0 +1,99 @@
+"""Host-side exact fingerprint set for delayed duplicate detection.
+
+The device-resident fingerprint tables cap distinct-state capacity at
+~2^28 slots (the 2 GiB single-buffer limit — measured into on the elect5
+campaign, RESULTS.md "capacity findings").  The DDD engine
+(ddd_engine.py) moves EXACT dedup to the host: candidate keys stream off
+the device, and this module maintains the master set of every discovered
+state's 64-bit fingerprint as a single sorted array, deduplicating
+pending candidates in *first-occurrence stream order* so discovery order
+— and therefore counts, levels, coverage attribution and traces — stays
+byte-identical to the table engines and the pure-Python oracle.
+
+Capacity is host RAM: 8 bytes/state (~15B states in this host's 125 GiB),
+three orders of magnitude past the device-table ceiling.  All operations
+are plain NumPy on sorted arrays (this host has one core — a threaded C++
+twin would buy nothing; `np.sort`/`np.searchsorted`/`np.insert` already
+run at memory bandwidth).
+
+Replicates TLC's external-memory fingerprint-set regime (the disk-backed
+`states/` dir the reference ignores at `/root/reference/.gitignore:2`),
+host-RAM-resident instead of disk-resident.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+U64 = np.uint64
+
+
+def pack_keys(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """Fuse the (hi, lo) uint32 fingerprint lanes the device engines use
+    (device_engine._dedup_insert keys) into one uint64 key per candidate."""
+    return (hi.astype(U64) << U64(32)) | lo.astype(U64)
+
+
+class MasterKeys:
+    """Sorted master array of discovered-state fingerprints.
+
+    ``dedup(keys)`` is the only mutating operation: given one flush of
+    candidate keys in stream order, it returns the indices (into that
+    flush, ascending) of candidates that are genuinely new — first
+    occurrence within the flush AND absent from the master — and merges
+    exactly those keys in.  Cross-flush first-occurrence order holds
+    because flush i's new keys are in the master before flush i+1 is
+    examined.
+    """
+
+    def __init__(self, keys: np.ndarray | None = None):
+        self._m = np.empty(0, U64) if keys is None \
+            else np.ascontiguousarray(keys, dtype=U64)
+        if self._m.size and np.any(self._m[1:] <= self._m[:-1]):
+            raise ValueError("master keys must be strictly sorted")
+
+    def __len__(self) -> int:
+        return int(self._m.size)
+
+    @property
+    def array(self) -> np.ndarray:
+        """The sorted master array (read-only view; for checkpointing)."""
+        v = self._m.view()
+        v.flags.writeable = False
+        return v
+
+    def seed(self, key: int) -> None:
+        """Insert one key (the initial state) into an empty-or-small set."""
+        self._m = np.unique(np.append(self._m, U64(key)))
+
+    def contains(self, keys: np.ndarray) -> np.ndarray:
+        keys = keys.astype(U64, copy=False)
+        pos = np.searchsorted(self._m, keys)
+        inb = pos < self._m.size
+        hit = np.zeros(keys.shape, bool)
+        hit[inb] = self._m[pos[inb]] == keys[inb]
+        return hit
+
+    def dedup(self, keys: np.ndarray) -> np.ndarray:
+        """First-occurrence indices of new keys, in stream order; merges
+        the corresponding keys into the master."""
+        keys = keys.astype(U64, copy=False)
+        n = keys.size
+        if n == 0:
+            return np.empty(0, np.int64)
+        order = np.argsort(keys, kind="stable")   # stable: ties keep
+        sk = keys[order]                          # stream order
+        first = np.ones(n, bool)
+        first[1:] = sk[1:] != sk[:-1]
+        cand_idx = order[first]                   # first occurrence per key
+        cand_keys = sk[first]
+        pos = np.searchsorted(self._m, cand_keys)
+        inb = pos < self._m.size
+        dup = np.zeros(cand_keys.shape, bool)
+        dup[inb] = self._m[pos[inb]] == cand_keys[inb]
+        new_idx = cand_idx[~dup]
+        if new_idx.size:
+            # np.insert positions refer to the pre-insert array, so one
+            # O(master + new) pass merges the whole sorted batch
+            self._m = np.insert(self._m, pos[~dup], cand_keys[~dup])
+        return np.sort(new_idx)

@@ -267,3 +267,14 @@ def test_cli_streamed_and_pagedshard_engines(tmp_path):
                         "--max-msgs", "2", "--chunk", "64",
                         "--cap", "65536", "--devices", "8")
     assert code == 0 and "3014 distinct states" in out
+
+
+def test_cli_ddd_engine(tmp_path):
+    """The DDD engine runs end-to-end from the CLI with the standard
+    report and exit code."""
+    cfg = write_cfg(tmp_path / "e.cfg")
+    code, out = run_cli(cfg, "--engine", "ddd", "--spec", "election",
+                        "--max-term", "2", "--max-log", "0",
+                        "--max-msgs", "2", "--chunk", "64",
+                        "--cap", "65536")
+    assert code == 0 and "3014 distinct states" in out

@@ -1,0 +1,178 @@
+"""Delayed-duplicate-detection engine (ddd_engine.py).
+
+The engine exists because the exact device fingerprint table caps
+distinct-state capacity at ~2^28 slots (the elect5 campaign measured into
+that ceiling — RESULTS.md "capacity findings"); its gates: oracle-exact
+parity with blocks/chunks small enough to cycle many times, IDENTICAL
+results under forced filter-table eviction (the lossy filter must never
+change a verdict or a count), refbfs-exact violation/deadlock stops,
+trace replay, and block-boundary checkpoint/resume with exact counters.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+from raft_tla_tpu.models import interp, refbfs
+
+CFG = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                max_log=0, max_msgs=2),
+                  spec="election", invariants=("NoTwoLeaders",), chunk=32)
+CAPS = DDDCapacities(block=256, table=1 << 14, flush=1 << 10, levels=64)
+
+
+def test_parity_with_oracle_tiny_blocks_and_flushes():
+    ref = refbfs.check(CFG)
+    got = DDDEngine(CFG, CAPS).check()
+    assert got.n_states == ref.n_states == 3014
+    assert got.diameter == ref.diameter == 17
+    assert got.levels == ref.levels
+    assert got.n_transitions == ref.n_transitions
+    assert got.coverage == ref.coverage      # identical discovery order
+    assert got.violation is None and got.complete
+
+
+def test_parity_under_forced_eviction():
+    """A 128-slot filter on a 3014-state space evicts constantly; the
+    host dedup must absorb every false-new re-sight — identical counts,
+    levels, coverage, discovery order."""
+    ref = refbfs.check(CFG)
+    caps = DDDCapacities(block=256, table=1 << 7, flush=1 << 9, levels=64)
+    got = DDDEngine(CFG, caps).check()
+    assert got.n_states == ref.n_states
+    assert got.levels == ref.levels
+    assert got.n_transitions == ref.n_transitions
+    assert got.coverage == ref.coverage
+
+
+def test_capacity_past_device_table_scale():
+    """The filter table is NOT a state-count ceiling: a space 8x larger
+    than the filter completes exactly (the table engines would
+    FAIL_PROBE here)."""
+    cfg = CheckConfig(bounds=Bounds(n_servers=3, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=1),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=64)
+    caps = DDDCapacities(block=1 << 13, table=1 << 14, flush=1 << 14,
+                         levels=64)
+    got = DDDEngine(cfg, caps).check()
+    assert got.n_states == 142538
+    assert got.diameter == 31
+    assert got.complete
+
+
+def test_violation_trace_replays_and_stops_exactly():
+    from raft_tla_tpu.models import invariants as inv_mod
+    from raft_tla_tpu.models import spec as S
+    from raft_tla_tpu.ops import msgbits as mb
+
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=4, max_dup=1)
+    cfg = CheckConfig(bounds=bounds, spec="election",
+                      invariants=("NaiveNoTwoLeaders",), chunk=64)
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3),
+        votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100),
+        msgs=tuple(sorted((m, 1) for m in
+                          (mb.rv_response(3, 1, 1, 2),))),
+    )
+    ref = refbfs.check(cfg, init_override=start)
+    caps = DDDCapacities(block=1 << 12, table=1 << 17, flush=1 << 12,
+                         levels=64)
+    got = DDDEngine(cfg, caps).check(init_override=start)
+    assert got.violation is not None
+    assert got.violation.invariant == "NaiveNoTwoLeaders"
+    # device-side stream truncation makes the stop refbfs-exact
+    assert got.n_states == ref.n_states
+    trace = got.violation.trace
+    assert trace[0][0] is None and trace[0][1] == start
+    for (_l, prev), (_label, cur) in zip(trace, trace[1:]):
+        succs = [t for _i, t in interp.successors(prev, bounds,
+                                                  spec="election")]
+        assert cur in succs
+    assert not inv_mod.py_invariant("NaiveNoTwoLeaders")(
+        got.violation.state, bounds)
+
+
+def test_checkpoint_resume_bit_exact(tmp_path):
+    ck = str(tmp_path / "ddd.ckpt")
+    straight = DDDEngine(CFG, CAPS).check()
+    res = DDDEngine(CFG, CAPS).check(checkpoint=ck,
+                                     checkpoint_every_s=0.0)
+    assert res.n_states == straight.n_states
+    resumed = DDDEngine(CFG, CAPS).check(resume=ck)
+    assert resumed.n_states == straight.n_states
+    assert resumed.levels == straight.levels
+    assert resumed.n_transitions == straight.n_transitions
+    assert resumed.coverage == straight.coverage
+    assert resumed.violation is None
+
+    other = DDDEngine(CFG, DDDCapacities(block=512, table=1 << 14,
+                                         flush=1 << 10, levels=64))
+    with pytest.raises(ValueError, match="checkpoint"):
+        other.check(resume=ck)
+
+
+def test_symmetry_composes():
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      symmetry=("Server",), chunk=32)
+    ref = refbfs.check(cfg)
+    got = DDDEngine(cfg, CAPS).check()
+    assert got.n_states == ref.n_states == 1514
+    assert got.diameter == ref.diameter
+    assert got.coverage == ref.coverage
+
+
+def test_deadlock_detected():
+    cfg = CheckConfig(bounds=Bounds(n_servers=1, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=(), chunk=16,
+                      check_deadlock=True)
+    ref = refbfs.check(cfg)
+    caps = DDDCapacities(block=64, table=1 << 12, flush=1 << 8, levels=64)
+    got = DDDEngine(cfg, caps).check()
+    assert ref.violation is not None and got.violation is not None
+    assert got.violation.invariant == ref.violation.invariant  # DEADLOCK
+    assert got.n_states == ref.n_states
+
+
+def test_faithful_mode_parity():
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=1, max_msgs=2, history=True,
+                                    max_elections=4),
+                      spec="full",
+                      invariants=("NoTwoLeaders", "ElectionSafetyHist",
+                                  "AllLogsPrefixClosed"), chunk=512)
+    ref = refbfs.check(cfg)
+    assert (ref.n_states, ref.diameter) == (53398, 32)
+    caps = DDDCapacities(block=1 << 13, table=1 << 18, flush=1 << 15,
+                         levels=64)
+    got = DDDEngine(cfg, caps).check()
+    assert (got.n_states, got.diameter) == (ref.n_states, ref.diameter)
+    assert got.levels == ref.levels
+    assert got.coverage == ref.coverage
+    assert got.violation is None
+
+
+def test_masterkeys_unit():
+    from raft_tla_tpu.utils.keyset import MasterKeys
+
+    m = MasterKeys()
+    m.seed(7)
+    keys = np.array([9, 3, 9, 7, 3, 11], np.uint64)
+    new = m.dedup(keys)
+    # first occurrences of 9, 3, 11 (7 already present), stream order
+    assert new.tolist() == [0, 1, 5]
+    assert len(m) == 4
+    assert m.contains(np.array([3, 4, 7, 9, 11], np.uint64)).tolist() == \
+        [True, False, True, True, True]
+    # second flush: all duplicates
+    assert m.dedup(keys).size == 0
+    # strictly-new flush merges in order
+    assert m.dedup(np.array([2, 1, 2], np.uint64)).tolist() == [0, 1]
+    assert m.array.tolist() == [1, 2, 3, 7, 9, 11]
