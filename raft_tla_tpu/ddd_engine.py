@@ -88,11 +88,16 @@ _IDX_CEIL = (1 << 31) - (1 << 24)
 class DDDCapacities:
     """Static shapes.  ``block``: frontier upload granularity; ``table``:
     lossy filter slots (traffic optimization only — NOT a state-count
-    ceiling); ``flush``: pending candidates per host dedup pass;
-    ``levels``: host-side BFS-depth bound."""
+    ceiling); ``seg_rows``: device output-buffer rows per segment (a
+    segment runs many chunks inside one dispatch and stops early when the
+    next chunk might not fit — dispatch round-trips over the deployment
+    tunnel cost ~100-300 ms, so per-chunk dispatch is ~10x slower);
+    ``flush``: pending candidates per host dedup pass; ``levels``:
+    host-side BFS-depth bound."""
 
     block: int = 1 << 20
     table: int = 1 << 26
+    seg_rows: int = 1 << 19
     flush: int = 1 << 23
     levels: int = 1 << 12
 
@@ -106,21 +111,36 @@ class DDDCapacities:
                 f"table={self.table} must be >= one bucket ({BUCKET})")
 
 
-class ChunkOut(NamedTuple):
+@dataclasses.dataclass(frozen=True)
+class _DigestCaps:
+    """Checkpoint-identity view of DDDCapacities: only fields that change
+    what a snapshot MEANS join the digest.  ``block`` denominates
+    ``blocks_done``; ``levels`` bounds the search.  ``table`` (lossy
+    filter), ``seg_rows`` and ``flush`` provably cannot affect discovery
+    order or any checkpointed byte, so tuning them mid-campaign must not
+    orphan a multi-hour snapshot.  Defaults mirror DDDCapacities so
+    default-valued fields keep dropping out of the digest (_stable)."""
+
+    block: int = 1 << 20
+    levels: int = 1 << 12
+
+
+class SegCarry(NamedTuple):
     tbl_hi: jax.Array     # [TB, BUCKET] lossy filter (donated through)
     tbl_lo: jax.Array
-    okey_hi: jax.Array    # [N] compacted candidate stream ---------------
+    okey_hi: jax.Array    # [OCAP] compacted candidate stream (donated) --
     okey_lo: jax.Array
-    orows: jax.Array      # [N, P] bit-packed successor rows
-    opar: jax.Array       # [N] parent discovery index
-    olane: jax.Array      # [N] action lane
-    ocon: jax.Array       # [N] constraint flag ---------------------------
-    n_stream: jax.Array   # compacted count
+    orows: jax.Array      # [OCAP, P] bit-packed successor rows
+    opar: jax.Array       # [OCAP] parent discovery index
+    olane: jax.Array      # [OCAP] action lane
+    ocon: jax.Array       # [OCAP] constraint flag ------------------------
+    cursor: jax.Array     # streamed rows this segment (output fill)
     n_valid: jax.Array    # transitions counted (truncated at violation)
     fail: jax.Array       # FAIL_WIDTH bit
     viol_kind: jax.Array  # 0 none / 1 invariant / 2 deadlock
     viol_inv: jax.Array   # invariant index (kind 1)
     dead_g: jax.Array     # kind 2: dead state's discovery index
+    c: jax.Array          # chunk cursor within the current block
 
 
 def _filter_insert(tbl_hi, tbl_lo, key_hi, key_lo, active):
@@ -162,16 +182,27 @@ def _filter_insert(tbl_hi, tbl_lo, key_hi, key_lo, active):
     return tbl_hi, tbl_lo, stream
 
 
-def _build_chunk(config: CheckConfig, caps: DDDCapacities, A: int, W: int,
-                 schema: bitpack.BitSchema):
+def _build_segment(config: CheckConfig, caps: DDDCapacities, A: int,
+                   W: int, schema: bitpack.BitSchema):
+    """One dispatch = up to ``budget`` chunks via ``lax.while_loop``,
+    compacting every chunk's candidate stream into the segment output
+    buffers at a running cursor.  The loop stops when the block is done,
+    the next chunk might overflow the output buffers, a violation or
+    failure is flagged, or the budget is spent."""
     B = config.chunk
     N = B * A
+    OCAP = caps.seg_rows
+    if OCAP < N:
+        raise ValueError(
+            f"seg_rows={OCAP} must be >= chunk * actions = {N}")
     n_inv = len(config.invariants)
     step = kernels.build_step(config.bounds, config.spec,
                               tuple(config.invariants), config.symmetry)
     BIG = jnp.int32(np.iinfo(np.int32).max)
 
-    def chunk(tbl_hi, tbl_lo, fbuf, fcon, block_start, block_rows, c):
+    def chunk_body(carry: SegCarry) -> SegCarry:
+        (tbl_hi, tbl_lo, okey_hi, okey_lo, orows, opar, olane, ocon,
+         cursor, n_valid_a, fail, viol_kind, viol_inv, dead_g, c) = carry
         r0 = c * B
         rows_b = r0 + jnp.arange(B, dtype=I32)
         row_act = rows_b < block_rows
@@ -203,37 +234,58 @@ def _build_chunk(config: CheckConfig, caps: DDDCapacities, A: int, W: int,
                              jnp.where(first_inv < BIG, first_inv, BIG))
         keep = iota <= cut_incl
         kvalid = fvalid & keep
-        n_valid = jnp.sum(kvalid.astype(I32))
-        fail = jnp.any(kvalid & out["overflow"].reshape(-1)).astype(I32) \
-            * FAIL_WIDTH
+        n_valid_a = n_valid_a + jnp.sum(kvalid.astype(I32))
+        fail = fail | jnp.any(
+            kvalid & out["overflow"].reshape(-1)).astype(I32) * FAIL_WIDTH
 
         fhi = out["fp_hi"].reshape(-1)
         flo = out["fp_lo"].reshape(-1)
         tbl_hi, tbl_lo, stream = _filter_insert(tbl_hi, tbl_lo, fhi, flo,
                                                 kvalid)
-        pos = jnp.cumsum(stream.astype(I32)) - 1
-        n_stream = jnp.sum(stream.astype(I32))
-        sl = jnp.where(stream, pos, N)
+        pos = cursor + jnp.cumsum(stream.astype(I32)) - 1
+        sl = jnp.where(stream, pos, OCAP)
         svecs = schema.pack(out["svecs"].reshape(N, W), jnp)
-        okey_hi = jnp.zeros((N,), U32).at[sl].set(fhi, mode="drop")
-        okey_lo = jnp.zeros((N,), U32).at[sl].set(flo, mode="drop")
-        orows = jnp.zeros((N, schema.P), I32).at[sl].set(svecs, mode="drop")
-        opar = jnp.zeros((N,), I32).at[sl].set(
-            block_start + r0 + iota // A, mode="drop")
-        olane = jnp.zeros((N,), I32).at[sl].set(iota % A, mode="drop")
-        ocon = jnp.zeros((N,), bool).at[sl].set(
-            out["con_ok"].reshape(-1), mode="drop")
+        okey_hi = okey_hi.at[sl].set(fhi, mode="drop")
+        okey_lo = okey_lo.at[sl].set(flo, mode="drop")
+        orows = orows.at[sl].set(svecs, mode="drop")
+        opar = opar.at[sl].set(block_start + r0 + iota // A, mode="drop")
+        olane = olane.at[sl].set(iota % A, mode="drop")
+        ocon = ocon.at[sl].set(out["con_ok"].reshape(-1), mode="drop")
+        cursor = cursor + jnp.sum(stream.astype(I32))
 
-        viol_kind = jnp.where(use_dead, 2, jnp.where(has_inv, 1, 0))
-        viol_inv = jnp.argmax(~out["inv_ok"].reshape(N, n_inv)[
+        viol_kind = jnp.where(use_dead, 2, jnp.where(has_inv, 1, 0)) \
+            .astype(I32)
+        viol_inv_c = jnp.argmax(~out["inv_ok"].reshape(N, n_inv)[
             jnp.minimum(first_inv, N - 1)]) if n_inv else jnp.int32(0)
-        dead_g = block_start + r0 + jnp.minimum(drow, B - 1)
-        return ChunkOut(tbl_hi, tbl_lo, okey_hi, okey_lo, orows, opar,
-                        olane, ocon, n_stream, n_valid, fail,
-                        viol_kind.astype(I32), viol_inv.astype(I32),
-                        dead_g)
+        dead_g = jnp.where(
+            use_dead, block_start + r0 + jnp.minimum(drow, B - 1), dead_g)
+        return SegCarry(tbl_hi, tbl_lo, okey_hi, okey_lo, orows, opar,
+                        olane, ocon, cursor, n_valid_a, fail, viol_kind,
+                        viol_inv_c.astype(I32), dead_g, c + 1)
 
-    return chunk
+    def cond(sc):
+        s, carry = sc
+        n_chunks = (block_rows + B - 1) // B
+        return ((carry.c < n_chunks) & (carry.viol_kind == 0)
+                & (carry.fail == 0) & (s < budget)
+                & (carry.cursor + N <= OCAP))
+
+    def body(sc):
+        s, carry = sc
+        return s + 1, chunk_body(carry)
+
+    def segment(carry, fbuf_, fcon_, budget_, block_start_, block_rows_):
+        nonlocal fbuf, fcon, budget, block_start, block_rows
+        fbuf, fcon = fbuf_, fcon_
+        budget = budget_
+        block_start, block_rows = block_start_, block_rows_
+        steps, carry = jax.lax.while_loop(cond, body,
+                                          (jnp.int32(0), carry))
+        n_chunks = (block_rows + B - 1) // B
+        return steps, carry.c >= n_chunks, carry
+
+    fbuf = fcon = budget = block_start = block_rows = None
+    return segment
 
 
 @functools.lru_cache(maxsize=64)
@@ -249,8 +301,13 @@ class DDDEngine:
     state capacity is host RAM, with no device fingerprint table in the
     correctness path."""
 
+    SEG_TARGET_S = 8.0
+    SEG_CLAMP_S = 25.0
+    SEG_MIN, SEG_MAX = 4, 1 << 16
+
     def __init__(self, config: CheckConfig,
-                 caps: DDDCapacities | None = None):
+                 caps: DDDCapacities | None = None,
+                 seg_chunks: int = 64):
         self.config = config
         self.bounds = config.bounds
         self.lay = st.Layout.of(self.bounds)
@@ -259,16 +316,30 @@ class DDDEngine:
         self.caps = caps or DDDCapacities()
         if self.caps.block < config.chunk:
             raise ValueError("block must be >= chunk")
+        self.seg_chunks = seg_chunks
+        self._digest_caps = _DigestCaps(block=self.caps.block,
+                                        levels=self.caps.levels)
         self.schema = bitpack.BitSchema(self.bounds)
-        self._chunk = jax.jit(
-            _build_chunk(config, self.caps, self.A, self.lay.width,
-                         self.schema),
-            donate_argnums=(0, 1))
+        self._segment = jax.jit(
+            _build_segment(config, self.caps, self.A, self.lay.width,
+                           self.schema),
+            donate_argnums=(0,))
 
-    def _fresh_filter(self):
+    def _init_segcarry(self) -> SegCarry:
         TB = self.caps.table // BUCKET
-        return (jnp.full((TB, BUCKET), _EMPTY, U32),
-                jnp.full((TB, BUCKET), _EMPTY, U32))
+        OCAP = self.caps.seg_rows
+        return SegCarry(
+            tbl_hi=jnp.full((TB, BUCKET), _EMPTY, U32),
+            tbl_lo=jnp.full((TB, BUCKET), _EMPTY, U32),
+            okey_hi=jnp.zeros((OCAP,), U32),
+            okey_lo=jnp.zeros((OCAP,), U32),
+            orows=jnp.zeros((OCAP, self.schema.P), I32),
+            opar=jnp.zeros((OCAP,), I32),
+            olane=jnp.zeros((OCAP,), I32),
+            ocon=jnp.zeros((OCAP,), bool),
+            cursor=jnp.int32(0), n_valid=jnp.int32(0),
+            fail=jnp.int32(0), viol_kind=jnp.int32(0),
+            viol_inv=jnp.int32(0), dead_g=jnp.int32(-1), c=jnp.int32(0))
 
     # -- host dedup -----------------------------------------------------
 
@@ -323,11 +394,11 @@ class DDDEngine:
             level_ends=np.asarray(level_ends, np.int64),
             blocks_done=np.int64(blocks_done),
             config_digest=np.uint64(
-                ckpt.config_digest(self.config, self.caps, init_key)))
+                ckpt.config_digest(self.config, self._digest_caps, init_key)))
 
     def load_checkpoint(self, path: str, init_key):
         with ckpt.load_npz_checked(
-                path, ckpt.config_digest(self.config, self.caps,
+                path, ckpt.config_digest(self.config, self._digest_caps,
                                          init_key)) as z:
             n_states = int(z["n_states"])
             n_trans = int(z["n_trans"])
@@ -425,16 +496,20 @@ class DDDEngine:
             level_ends = [1]
             blocks_done = 0
 
-        tbl_hi, tbl_lo = self._fresh_filter()   # filter ≠ correctness:
+        carry = self._init_segcarry()           # filter ≠ correctness:
         pend = {"keys": [], "rows": [], "par": [],  # resume starts empty
                 "lane": [], "con": []}
         Fcap = self.caps.block
+        OCAP = self.caps.seg_rows
         viol = None          # (kind, inv_idx, dead_g) once detected
         viol_key = None
         fail = 0
         complete = True
         stopped = False
         t_warm = None
+        first = True
+        budget = max(1, self.seg_chunks)
+        worst_s_per_chunk = 0.0
         last_ckpt = time.monotonic()
 
         def progress():
@@ -468,39 +543,41 @@ class DDDEngine:
                         [con, np.zeros((Fcap - b_rows,), bool)])
                 fbuf = jnp.asarray(blk)
                 fcon = jnp.asarray(con)
-                n_chunks = (b_rows + B - 1) // B
-                for c in range(n_chunks):
+                carry = carry._replace(c=jnp.int32(0))
+                block_done = False
+                while not block_done:
                     if (deadline_s is not None and t_warm is not None
                             and time.monotonic() - t_warm > deadline_s):
                         complete = False
                         stopped = True
                         break
-                    o = self._chunk(tbl_hi, tbl_lo, fbuf, fcon,
-                                    jnp.int32(b_start), jnp.int32(b_rows),
-                                    jnp.int32(c))
-                    tbl_hi, tbl_lo = o.tbl_hi, o.tbl_lo
+                    t_seg = time.monotonic()
+                    steps_d, done_d, carry = self._segment(
+                        carry, fbuf, fcon, jnp.int32(budget),
+                        jnp.int32(b_start), jnp.int32(b_rows))
                     (ns, nv, fl, vk) = map(int, jax.device_get(
-                        (o.n_stream, o.n_valid, o.fail, o.viol_kind)))
+                        (carry.cursor, carry.n_valid, carry.fail,
+                         carry.viol_kind)))
                     n_trans += nv
                     fail |= fl
                     if ns:
                         k = max(1024, 1 << (ns - 1).bit_length())
                         kh, kl, rws, par, lan, cn = jax.device_get(
-                            _slicer(min(k, N))(
-                                o.okey_hi, o.okey_lo, o.orows, o.opar,
-                                o.olane, o.ocon))
+                            _slicer(min(k, OCAP))(
+                                carry.okey_hi, carry.okey_lo, carry.orows,
+                                carry.opar, carry.olane, carry.ocon))
                         pend["keys"].append(
                             keyset.pack_keys(kh[:ns], kl[:ns]))
                         pend["rows"].append(rws[:ns])
                         pend["par"].append(par[:ns])
                         pend["lane"].append(lan[:ns])
                         pend["con"].append(cn[:ns])
-                    if t_warm is None:
-                        t_warm = time.monotonic()
+                    carry = carry._replace(cursor=jnp.int32(0),
+                                           n_valid=jnp.int32(0))
                     if vk or fail:
                         if vk:
                             vi, dg = map(int, jax.device_get(
-                                (o.viol_inv, o.dead_g)))
+                                (carry.viol_inv, carry.dead_g)))
                             viol = (vk, vi, dg)
                             if vk == 1:
                                 # truncation makes the violator the last
@@ -509,6 +586,23 @@ class DDDEngine:
                                 viol_key = pend["keys"][-1][-1]
                         stopped = True
                         break
+                    dt = time.monotonic() - t_seg
+                    executed = max(1, int(steps_d))
+                    if not first and dt > 0.05:
+                        worst_s_per_chunk = max(worst_s_per_chunk,
+                                                dt / executed)
+                        scale = min(2.0, max(0.25,
+                                             self.SEG_TARGET_S / dt))
+                        budget = int(min(self.SEG_MAX, max(
+                            self.SEG_MIN, budget * scale)))
+                        budget = max(self.SEG_MIN, min(
+                            budget,
+                            int(self.SEG_CLAMP_S / worst_s_per_chunk)))
+                        self.seg_chunks = budget
+                    if first:
+                        t_warm = time.monotonic()
+                    first = False
+                    block_done = bool(done_d)
                     if sum(len(x) for x in pend["keys"]) >= \
                             self.caps.flush:
                         n_states += self._flush(pend, master, host,
