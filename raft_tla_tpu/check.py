@@ -307,13 +307,19 @@ def _run(args, config):
                          checkpoint_every_s=args.checkpoint_every,
                          resume=args.resume)
     if args.engine == "ddd":
+        from raft_tla_tpu.models import spec as S
         from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
         # the filter table is a traffic optimization, not a capacity
         # bound — size it to the expected state count, capped at the
         # 2 GiB-buffer limit the exact tables live under
         table = 1 << max(10, min(28, (2 * args.cap - 1).bit_length()))
+        # segment output buffers must hold at least one chunk's worst-case
+        # candidate stream (chunk * action fan-out)
+        A = len(S.action_table(config.bounds, config.spec))
+        seg_rows = max(1 << 19, 2 * args.chunk * A)
         eng = DDDEngine(config, DDDCapacities(
-            block=1 << 20, table=table, levels=args.levels))
+            block=1 << 20, table=table, seg_rows=seg_rows,
+            levels=args.levels))
         return eng.check(on_progress=_stats_cb(args),
                          checkpoint=args.checkpoint,
                          checkpoint_every_s=args.checkpoint_every,

@@ -75,6 +75,7 @@ from raft_tla_tpu.ops import symmetry as sym_mod
 from raft_tla_tpu.utils import ckpt
 from raft_tla_tpu.utils import keyset
 from raft_tla_tpu.utils import native
+from raft_tla_tpu.utils import pacing
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -119,7 +120,9 @@ class _DigestCaps:
     filter), ``seg_rows`` and ``flush`` provably cannot affect discovery
     order or any checkpointed byte, so tuning them mid-campaign must not
     orphan a multi-hour snapshot.  Defaults mirror DDDCapacities so
-    default-valued fields keep dropping out of the digest (_stable)."""
+    default-valued fields keep dropping out of the digest (_stable).
+    Introducing this class rotated the digest once (the class NAME joins
+    the _stable tuple); no snapshot predating it existed outside tests."""
 
     block: int = 1 << 20
     levels: int = 1 << 12
@@ -507,9 +510,10 @@ class DDDEngine:
         complete = True
         stopped = False
         t_warm = None
-        first = True
-        budget = max(1, self.seg_chunks)
-        worst_s_per_chunk = 0.0
+        pacer = pacing.SegmentPacer(self.seg_chunks, self.SEG_MIN,
+                                    self.SEG_MAX, self.SEG_TARGET_S,
+                                    self.SEG_CLAMP_S)
+        budget = pacer.budget
         last_ckpt = time.monotonic()
 
         def progress():
@@ -587,21 +591,10 @@ class DDDEngine:
                         stopped = True
                         break
                     dt = time.monotonic() - t_seg
-                    executed = max(1, int(steps_d))
-                    if not first and dt > 0.05:
-                        worst_s_per_chunk = max(worst_s_per_chunk,
-                                                dt / executed)
-                        scale = min(2.0, max(0.25,
-                                             self.SEG_TARGET_S / dt))
-                        budget = int(min(self.SEG_MAX, max(
-                            self.SEG_MIN, budget * scale)))
-                        budget = max(self.SEG_MIN, min(
-                            budget,
-                            int(self.SEG_CLAMP_S / worst_s_per_chunk)))
-                        self.seg_chunks = budget
-                    if first:
+                    if t_warm is None:
                         t_warm = time.monotonic()
-                    first = False
+                    budget = pacer.update(dt, max(1, int(steps_d)))
+                    self.seg_chunks = budget
                     block_done = bool(done_d)
                     if sum(len(x) for x in pend["keys"]) >= \
                             self.caps.flush:

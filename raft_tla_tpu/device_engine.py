@@ -69,6 +69,7 @@ from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym_mod
 from raft_tla_tpu.utils import ckpt
+from raft_tla_tpu.utils import pacing
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -588,9 +589,10 @@ class DeviceEngine:
         # the search state never moves.  The budget is retuned each dispatch
         # toward SEG_TARGET_S seconds (the first, compile-carrying dispatch
         # is excluded from the timing signal).
-        budget = max(1, self.seg_chunks)    # 0/negative would spin forever
-        first = True
-        worst_s_per_chunk = 0.0
+        pacer = pacing.SegmentPacer(self.seg_chunks, self.SEG_MIN,
+                                    self.SEG_MAX, self.SEG_TARGET_S,
+                                    self.SEG_CLAMP_S)
+        budget = pacer.budget
         last_ckpt = time.monotonic()
         while True:
             t_seg = time.monotonic()
@@ -604,22 +606,11 @@ class DeviceEngine:
                                >= checkpoint_every_s):
                 self.save_checkpoint(checkpoint, carry, (hi0, lo0))
                 last_ckpt = time.monotonic()
-            if not first and dt > 0.05:
-                # In the run's cheap tail (tiny ragged levels) the budget
-                # ramps geometrically; the next wide level would then run
-                # one segment far past the tunnel watchdog, killing the
-                # worker mid-RPC.  Clamp so projected segment time stays
-                # under SEG_CLAMP_S at the worst chunk cost seen (dt/budget
-                # underestimates it when a segment exits early — only the
-                # final segments, harmless).
-                worst_s_per_chunk = max(worst_s_per_chunk, dt / budget)
-                scale = min(2.0, max(0.25, self.SEG_TARGET_S / dt))
-                budget = int(min(self.SEG_MAX,
-                                 max(self.SEG_MIN, budget * scale)))
-                budget = max(self.SEG_MIN, min(
-                    budget, int(self.SEG_CLAMP_S / worst_s_per_chunk)))
-                self.seg_chunks = budget    # warm check() calls start tuned
-            first = False
+            # this segment loop has no executed-chunk count; the requested
+            # budget only underestimates chunk cost on early-exiting final
+            # segments, which break above — harmless (pacing.py policy)
+            budget = pacer.update(dt, budget)
+            self.seg_chunks = budget        # warm check() calls start tuned
         if retain_carry:
             self.retained_carry = carry
         # One batched transfer for all the small outputs; the wide arrays

@@ -52,6 +52,7 @@ from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym_mod
 from raft_tla_tpu.utils import ckpt
 from raft_tla_tpu.utils import native
+from raft_tla_tpu.utils import pacing
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -379,11 +380,12 @@ class PagedEngine:
                 jnp.uint32(lo0),
                 jnp.bool_(interp.constraint_ok(init_py, bounds)))
             paged = 0
-        budget = max(1, self.seg_chunks)
-        first = True
+        pacer = pacing.SegmentPacer(self.seg_chunks, self.SEG_MIN,
+                                    self.SEG_MAX, self.SEG_TARGET_S,
+                                    self.SEG_CLAMP_S)
+        budget = pacer.budget
         complete = True
         t_warm = None
-        worst_s_per_chunk = 0.0
         last_ckpt = time.monotonic()
         while True:
             if (deadline_s is not None and t_warm is not None
@@ -411,16 +413,10 @@ class PagedEngine:
                 self.save_checkpoint(checkpoint, carry, host, paged,
                                      (hi0, lo0))
                 last_ckpt = time.monotonic()
-            if not first and dt > 0.05:
-                worst_s_per_chunk = max(worst_s_per_chunk, dt / executed)
-                scale = min(2.0, max(0.25, self.SEG_TARGET_S / dt))
-                budget = int(min(self.SEG_MAX,
-                                 max(self.SEG_MIN, budget * scale)))
-                budget = max(self.SEG_MIN, min(
-                    budget, int(self.SEG_CLAMP_S / worst_s_per_chunk)))
-            if first:
+            if t_warm is None:
                 t_warm = time.monotonic()   # deadline starts post-compile
-            first = False
+            budget = pacer.update(dt, executed)
+            self.seg_chunks = budget
 
         (viol_g, viol_i, n_trans, fail, n_levels, levels_dev,
          cov_arr) = jax.device_get((

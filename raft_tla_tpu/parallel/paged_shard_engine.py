@@ -61,7 +61,7 @@ from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym_mod
 from raft_tla_tpu.parallel.shard_engine import FAIL_ROUTE, make_mesh
-from raft_tla_tpu.utils import ckpt, native
+from raft_tla_tpu.utils import ckpt, native, pacing
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -525,9 +525,10 @@ class PagedShardEngine:
                      for _ in range(self.ndev)]
             paged = [0] * self.ndev
 
-        budget = max(1, self.seg_chunks)
-        first = True
-        worst_s_per_chunk = 0.0
+        pacer = pacing.SegmentPacer(self.seg_chunks, self.SEG_MIN,
+                                    self.SEG_MAX, self.SEG_TARGET_S,
+                                    self.SEG_CLAMP_S)
+        budget = pacer.budget
         last_ckpt = time.monotonic()
         while True:
             paged_d = jnp.asarray(np.asarray(paged, np.int32))
@@ -546,15 +547,8 @@ class PagedShardEngine:
                 self.save_checkpoint(checkpoint, carry, hosts, paged,
                                      (hi0, lo0))
                 last_ckpt = time.monotonic()
-            if not first and dt > 0.05:
-                worst_s_per_chunk = max(worst_s_per_chunk, dt / executed)
-                scale = min(2.0, max(0.25, self.SEG_TARGET_S / dt))
-                budget = int(min(self.SEG_MAX,
-                                 max(self.SEG_MIN, budget * scale)))
-                budget = max(self.SEG_MIN, min(
-                    budget, int(self.SEG_CLAMP_S / worst_s_per_chunk)))
-                self.seg_chunks = budget
-            first = False
+            budget = pacer.update(dt, executed)
+            self.seg_chunks = budget
 
         (n_states_d, viol_ls, viol_is, n_trans_d, fail_d, n_levels,
          levels_dev, cov_arr) = jax.device_get(

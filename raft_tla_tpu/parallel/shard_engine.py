@@ -78,6 +78,7 @@ from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym_mod
 from raft_tla_tpu.utils import ckpt
+from raft_tla_tpu.utils import pacing
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -484,9 +485,10 @@ class ShardEngine:
                 np.asarray(init_vec, np.int32), np.uint32(hi0),
                 np.uint32(lo0), bool(interp.constraint_ok(init_py, bounds)))
 
-        budget = max(1, self.seg_chunks)
-        first = True
-        worst_s_per_chunk = 0.0
+        pacer = pacing.SegmentPacer(self.seg_chunks, self.SEG_MIN,
+                                    self.SEG_MAX, self.SEG_TARGET_S,
+                                    self.SEG_CLAMP_S)
+        budget = pacer.budget
         last_ckpt = time.monotonic()
         while True:
             t_seg = time.monotonic()
@@ -501,21 +503,8 @@ class ShardEngine:
                                >= checkpoint_every_s):
                 self.save_checkpoint(checkpoint, carry, (hi0, lo0))
                 last_ckpt = time.monotonic()
-            if not first and dt > 0.05:
-                # Same watchdog clamp as DeviceEngine.check: never project a
-                # segment past SEG_CLAMP_S at the worst chunk cost seen —
-                # per EXECUTED chunk, not the requested budget.  Today only
-                # final (stop) segments exit early and those break above;
-                # dividing by the executed count keeps the estimate exact if
-                # a future pause/yield path ends a segment mid-budget.
-                worst_s_per_chunk = max(worst_s_per_chunk, dt / executed)
-                scale = min(2.0, max(0.25, self.SEG_TARGET_S / dt))
-                budget = int(min(self.SEG_MAX,
-                                 max(self.SEG_MIN, budget * scale)))
-                budget = max(self.SEG_MIN, min(
-                    budget, int(self.SEG_CLAMP_S / worst_s_per_chunk)))
-                self.seg_chunks = budget
-            first = False
+            budget = pacer.update(dt, executed)
+            self.seg_chunks = budget
 
         (n_states_d, viol_gs, viol_is, n_trans_d, fail_d, n_levels,
          levels_dev, cov_arr) = jax.device_get(

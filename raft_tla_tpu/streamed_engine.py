@@ -60,6 +60,7 @@ from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym_mod
 from raft_tla_tpu.utils import ckpt
 from raft_tla_tpu.utils import native
+from raft_tla_tpu.utils import pacing
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -400,11 +401,12 @@ class StreamedEngine:
             level_ends = [1]
             blocks_done = 0              # completed blocks, frontier level
 
-        budget = max(1, self.seg_chunks)
-        first = True
+        pacer = pacing.SegmentPacer(self.seg_chunks, self.SEG_MIN,
+                                    self.SEG_MAX, self.SEG_TARGET_S,
+                                    self.SEG_CLAMP_S)
+        budget = pacer.budget
         complete = True
         t_warm = None
-        worst_s_per_chunk = 0.0
         last_ckpt = time.monotonic()
         Fcap = self.caps.block
         stopped = False
@@ -448,21 +450,10 @@ class StreamedEngine:
                         stopped = True
                         break
                     dt = time.monotonic() - t_seg
-                    executed = max(1, int(steps_d))
-                    if not first and dt > 0.05:
-                        worst_s_per_chunk = max(worst_s_per_chunk,
-                                                dt / executed)
-                        scale = min(2.0, max(0.25,
-                                             self.SEG_TARGET_S / dt))
-                        budget = int(min(self.SEG_MAX, max(
-                            self.SEG_MIN, budget * scale)))
-                        budget = max(self.SEG_MIN, min(
-                            budget,
-                            int(self.SEG_CLAMP_S / worst_s_per_chunk)))
-                        self.seg_chunks = budget
-                    if first:
+                    if t_warm is None:
                         t_warm = time.monotonic()
-                    first = False
+                    budget = pacer.update(dt, max(1, int(steps_d)))
+                    self.seg_chunks = budget
                     block_done = bool(done_d)
                 if stopped:
                     break

@@ -1,0 +1,59 @@
+"""BASELINE config #2 campaign, round-2 DDD attempt: 5-server election,
+t2/m2, SYMMETRY Server — exhaustive, with no fingerprint-table ceiling.
+
+The streamed-engine v3 run reached 131.3M orbits into level 26 before the
+2^28 device-table ceiling (and a tunnel wedge) ended it; its checkpoint
+did not survive the environment reset.  This restarts the space on the
+DDD engine, whose exact dedup lives in host RAM (~15B-state capacity).
+
+Usage: python runs/elect5_ddd.py [resume]
+Checkpoints at runs/elect5ddd.ckpt every 15 min; stats stream appended to
+runs/elect5ddd.stats (one JSON line per flush/level).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+
+RUNS = os.path.dirname(os.path.abspath(__file__))
+CKPT = os.path.join(RUNS, "elect5ddd.ckpt")
+STATS = os.path.join(RUNS, "elect5ddd.stats")
+
+CFG = CheckConfig(
+    bounds=Bounds(n_servers=5, n_values=2, max_term=2, max_log=0,
+                  max_msgs=2, max_dup=1),
+    spec="election",
+    invariants=("NoTwoLeaders", "CommittedWithinLog"),
+    symmetry=("Server",), chunk=4096)
+
+CAPS = DDDCapacities(block=1 << 20, table=1 << 28, seg_rows=1 << 19,
+                     flush=1 << 23, levels=1 << 12)
+
+
+def main():
+    resume = CKPT if (len(sys.argv) > 1 and sys.argv[1] == "resume") \
+        else None
+    sf = open(STATS, "a", buffering=1)
+
+    def on_progress(s):
+        sf.write(json.dumps(s) + "\n")
+
+    eng = DDDEngine(CFG, CAPS)
+    r = eng.check(on_progress=on_progress, checkpoint=CKPT,
+                  checkpoint_every_s=900.0, resume=resume)
+    print(json.dumps({
+        "n_states": r.n_states, "diameter": r.diameter,
+        "n_transitions": r.n_transitions, "complete": r.complete,
+        "violation": r.violation.invariant if r.violation else None,
+        "levels": r.levels, "wall_s": round(r.wall_s, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

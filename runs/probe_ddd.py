@@ -1,0 +1,62 @@
+"""Time-boxed DDD-engine probes on the real chip.
+
+Usage: python runs/probe_ddd.py <workload> <deadline_s> <chunk>
+  workload: ns  = north-star-shaped symmetric full-Next 3s/2v (bench probe)
+            e5  = elect5-shaped symmetric 5s election t2/m2
+            c4  = config #4: symmetric full-Next 5s/2v t2/l1/m2
+Prints one JSON line of warm rates (same split as bench.run_northstar).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+
+WORKLOADS = {
+    "ns": dict(bounds=Bounds(n_servers=3, n_values=2, max_term=2,
+                             max_log=1, max_msgs=2, max_dup=1),
+               spec="full",
+               invariants=("NoTwoLeaders", "LogMatching",
+                           "CommittedWithinLog", "LeaderCompleteness")),
+    "e5": dict(bounds=Bounds(n_servers=5, n_values=2, max_term=2,
+                             max_log=0, max_msgs=2, max_dup=1),
+               spec="election",
+               invariants=("NoTwoLeaders", "CommittedWithinLog")),
+    "c4": dict(bounds=Bounds(n_servers=5, n_values=2, max_term=2,
+                             max_log=1, max_msgs=2, max_dup=1),
+               spec="full",
+               invariants=("NoTwoLeaders", "LogMatching",
+                           "CommittedWithinLog", "LeaderCompleteness")),
+}
+
+
+def main():
+    wl, deadline, chunk = (sys.argv[1], float(sys.argv[2]),
+                           int(sys.argv[3]))
+    cfg = CheckConfig(symmetry=("Server",), chunk=chunk, **WORKLOADS[wl])
+    eng = DDDEngine(cfg, DDDCapacities(block=1 << 20, table=1 << 26,
+                                       flush=1 << 23, levels=1 << 12))
+    stats: list = []
+    r = eng.check(deadline_s=deadline, on_progress=stats.append)
+    if len(stats) >= 2:
+        d_orbits = stats[-1]["n_states"] - stats[0]["n_states"]
+        d_wall = stats[-1]["wall_s"] - stats[0]["wall_s"]
+    else:
+        d_orbits, d_wall = r.n_states, r.wall_s
+    print(json.dumps({
+        "workload": wl, "chunk": chunk, "orbits": r.n_states,
+        "level": stats[-1]["level"] if stats else 0,
+        "orbits_per_sec": round(d_orbits / max(d_wall, 1e-9), 1),
+        "transitions": r.n_transitions,
+        "violation": r.violation is not None,
+        "complete": r.complete, "wall_s": round(r.wall_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Shared segment-pacing controller (utils/pacing.py) — the policy every
+segmented engine inlined before it was extracted."""
+
+from raft_tla_tpu.utils.pacing import SegmentPacer
+
+
+def mk(**kw):
+    args = dict(seg_chunks=64, lo=16, hi=1 << 16, target_s=8.0,
+                clamp_s=25.0)
+    args.update(kw)
+    return SegmentPacer(**args)
+
+
+def test_first_dispatch_excluded():
+    p = mk()
+    assert p.update(40.0, 64) == 64          # compile-carrying: no signal
+    assert p.worst_s_per_chunk == 0.0
+
+
+def test_scales_toward_target():
+    p = mk()
+    p.update(1.0, 64)                        # first: ignored
+    assert p.update(1.0, 64) == 128          # 8x under target -> 2x cap
+    assert p.update(32.0, 128) == 32         # 4x over target -> 0.25x floor
+
+
+def test_watchdog_clamp_uses_worst_chunk_cost():
+    p = mk()
+    p.update(0.1, 64)
+    p.update(8.0, 16)                        # 0.5 s/chunk observed
+    # whatever the target scaling wants, 25 s / 0.5 s = 50 chunks max
+    assert p.budget <= 50
+    p.update(0.1, 64)                        # cheap tail would ramp...
+    assert p.budget <= 50                    # ...but the ratchet holds
+
+
+def test_short_dispatches_carry_no_signal():
+    p = mk()
+    p.update(1.0, 64)
+    b = p.update(1.0, 64)
+    assert p.update(0.01, 64) == b
+
+
+def test_floor_and_zero_budget_guard():
+    p = mk(seg_chunks=0)
+    assert p.budget == 1                     # never spins forever
+    p.update(1.0, 1)
+    p.update(100.0, 1)                       # huge chunk cost
+    assert p.budget == 16                    # lo floor wins
