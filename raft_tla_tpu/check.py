@@ -92,6 +92,11 @@ def build_argparser() -> argparse.ArgumentParser:
                         "default: derived from --cap, at most 4M)")
     p.add_argument("--devices", type=int, default=None,
                    help="mesh size for --engine shard (default: all)")
+    p.add_argument("--slices", type=int, default=None,
+                   help="multi-slice scale-out for shard/pagedshard: build "
+                        "a 2-D (dcn, ici) mesh of N slices x (devices/N) "
+                        "chips with the hierarchical dedup exchange "
+                        "(default: single-slice 1-D mesh)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (virtual devices for shard)")
     p.add_argument("--emit-tlc", metavar="DIR",
@@ -256,6 +261,21 @@ def _simulate(args, config):
     return EXIT_DEADLOCK if is_deadlock else EXIT_VIOLATION
 
 
+
+def _make_cli_mesh(args):
+    """1-D mesh, or the 2-D (dcn, ici) slice mesh when --slices is given."""
+    import jax
+
+    from raft_tla_tpu.parallel.shard_engine import make_mesh, make_slice_mesh
+    if args.slices is None:
+        return make_mesh(args.devices)
+    nd = args.devices if args.devices is not None else len(jax.devices())
+    if nd % args.slices:
+        raise SystemExit(
+            f"--devices {nd} not divisible by --slices {args.slices}")
+    return make_slice_mesh(args.slices, nd // args.slices)
+
+
 def _run(args, config):
     if args.cpu:
         import jax
@@ -326,8 +346,9 @@ def _run(args, config):
                          resume=args.resume)
     if args.engine == "shard":
         from raft_tla_tpu.parallel.shard_engine import (
-            ShardCapacities, ShardEngine, make_mesh)
-        eng = ShardEngine(config, make_mesh(args.devices),
+            ShardCapacities, ShardEngine)
+        mesh = _make_cli_mesh(args)
+        eng = ShardEngine(config, mesh,
                           ShardCapacities(n_states=args.cap,
                                           levels=args.levels))
         return eng.check(checkpoint=args.checkpoint,
@@ -337,12 +358,11 @@ def _run(args, config):
         from raft_tla_tpu.models import spec as S
         from raft_tla_tpu.parallel.paged_shard_engine import (
             PagedShardCapacities, PagedShardEngine)
-        from raft_tla_tpu.parallel.shard_engine import make_mesh
         A = len(S.action_table(config.bounds, config.spec))
         # --cap is the expected distinct-state total across the mesh;
         # tables shard it, rings hold each device's live window share
         table = 1 << max(1, (2 * args.cap - 1).bit_length())
-        mesh = make_mesh(args.devices)
+        mesh = _make_cli_mesh(args)
         nd = mesh.devices.size
         ring = args.ring if args.ring is not None else max(
             1 << min(22, max(12, (args.cap // (4 * nd)).bit_length())),

@@ -60,7 +60,8 @@ from raft_tla_tpu.ops import bitpack
 from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym_mod
-from raft_tla_tpu.parallel.shard_engine import FAIL_ROUTE, make_mesh
+from raft_tla_tpu.parallel.shard_engine import (FAIL_ROUTE, _DCN,
+    _mesh_axes, exchange, make_mesh)
 from raft_tla_tpu.utils import ckpt, native, pacing
 
 I32 = jnp.int32
@@ -79,6 +80,8 @@ class PagedShardCapacities:
     table: int = 1 << 22
     levels: int = 512
     send: Optional[int] = None
+    send2: Optional[int] = None    # stage-B depth, 2-D meshes (see
+    #                                ShardCapacities.send2)
 
 
 class PSCarry(NamedTuple):
@@ -112,13 +115,15 @@ _SHARDED = ("store", "pdev", "pidx", "lane", "conflag", "tbl_hi", "tbl_lo",
             "n_trans", "cov", "fail")
 
 
-def _carry_specs():
-    return PSCarry(**{f: P(_AXIS) if f in _SHARDED else P()
+def _carry_specs(axes=(_AXIS,)):
+    ax = axes if len(axes) > 1 else axes[0]
+    return PSCarry(**{f: P(ax) if f in _SHARDED else P()
                       for f in PSCarry._fields})
 
 
 def _build_segment(config: CheckConfig, caps: PagedShardCapacities, A: int,
-                   W: int, ndev: int, schema: bitpack.BitSchema):
+                   W: int, ndev: int, schema: bitpack.BitSchema,
+                   nici: int | None = None, axes: tuple = (_AXIS,)):
     B = config.chunk
     n_inv = len(config.invariants)
     if n_inv > 29:
@@ -129,17 +134,23 @@ def _build_segment(config: CheckConfig, caps: PagedShardCapacities, A: int,
     rmask = Rcap - 1
     Pw = schema.P
     Csend = caps.send if caps.send is not None else B * A
+    nici = ndev if nici is None else nici
+    nslice = ndev // nici
+    Csend2 = caps.send2 if caps.send2 is not None else nici * Csend
+    NR = nici * Csend if nslice == 1 else nslice * Csend2
     BIG = jnp.int32(np.iinfo(np.int32).max)
     # Index-ceiling headroom must cover the worst-case per-chunk append,
-    # which here is ndev*Csend (every sender fills this owner's routing
-    # buffer) — not the single-device engine's 2*B*A.
-    IDX_CEIL = jnp.int32(np.iinfo(np.int32).max - 2 * ndev * Csend)
+    # which here is the full routed-buffer width NR (every sender fills
+    # this owner's routing buffer) — not the single-device engine's 2*B*A.
+    IDX_CEIL = jnp.int32(np.iinfo(np.int32).max - 2 * NR)
 
     def owner(key_hi):
         return (key_hi % jnp.uint32(ndev)).astype(I32)
 
     def chunk_body(carry: PSCarry) -> PSCarry:
-        dev = jax.lax.axis_index(_AXIS).astype(I32)
+        dev = jax.lax.axis_index(_AXIS).astype(I32) if nslice == 1 else (
+            jax.lax.axis_index(_DCN).astype(I32) * nici
+            + jax.lax.axis_index(_AXIS).astype(I32))
         lvl_start, lvl_end = carry.lvl_start[0], carry.lvl_end[0]
         n_states, fail = carry.n_states[0], carry.fail[0]
         viol_l, viol_i = carry.viol_l[0], carry.viol_i[0]
@@ -165,15 +176,6 @@ def _build_segment(config: CheckConfig, caps: PagedShardCapacities, A: int,
         fhi = out["fp_hi"].reshape(BA)
         flo = out["fp_lo"].reshape(BA)
         fvalid = valid.reshape(BA)
-        dest = jnp.where(fvalid, owner(fhi), ndev)
-        oh = (dest[:, None] == jnp.arange(ndev, dtype=I32)[None, :])
-        cum = jnp.cumsum(oh.astype(I32), axis=0)
-        pos = jnp.take_along_axis(
-            cum, jnp.clip(dest, 0, ndev - 1)[:, None], axis=1)[:, 0] - 1
-        fail = fail | jnp.any(fvalid & (pos >= Csend)) * FAIL_ROUTE
-        slot = jnp.where(fvalid & (pos < Csend), dest * Csend + pos,
-                         ndev * Csend)
-
         flat_b = jnp.arange(BA, dtype=I32) // A
         flat_a = jnp.arange(BA, dtype=I32) % A
         flags = jnp.ones((BA,), I32) | (
@@ -183,31 +185,29 @@ def _build_segment(config: CheckConfig, caps: PagedShardCapacities, A: int,
             flags = flags | jnp.sum(
                 iv << (2 + jnp.arange(n_inv, dtype=I32))[None, :], axis=1)
 
-        def scatter(val, fill, dtype):
-            buf = jnp.full((ndev * Csend,) + val.shape[1:], fill, dtype)
-            return buf.at[slot].set(val.astype(dtype), mode="drop")
-
         # the routed row is BIT-PACKED — the whole point of the composition
         svecs = schema.pack(out["svecs"].reshape(BA, W), jnp)
-        s_vec = scatter(svecs, 0, I32).reshape(ndev, Csend, Pw)
-        s_hi = scatter(fhi, _EMPTY, U32).reshape(ndev, Csend)
-        s_lo = scatter(flo, _EMPTY, U32).reshape(ndev, Csend)
-        s_pd = scatter(jnp.full((BA,), 0, I32) + dev, -1, I32).reshape(
-            ndev, Csend)
-        s_pi = scatter(rows_g[flat_b], -1, I32).reshape(ndev, Csend)
-        s_lane = scatter(flat_a, -1, I32).reshape(ndev, Csend)
-        s_flags = scatter(flags, 0, I32).reshape(ndev, Csend)
-
-        a2a = functools.partial(jax.lax.all_to_all, axis_name=_AXIS,
-                                split_axis=0, concat_axis=0, tiled=True)
-        r_vec = a2a(s_vec).reshape(ndev * Csend, Pw)
-        r_hi = a2a(s_hi).reshape(ndev * Csend)
-        r_lo = a2a(s_lo).reshape(ndev * Csend)
-        r_pd = a2a(s_pd).reshape(ndev * Csend)
-        r_pi = a2a(s_pi).reshape(ndev * Csend)
-        r_lane = a2a(s_lane).reshape(ndev * Csend)
-        r_flags = a2a(s_flags).reshape(ndev * Csend)
+        # stage A over ICI to the owner's in-slice chip (1-D: the whole
+        # exchange); stage B over DCN in aggregated per-slice blocks
+        dest_a = jnp.where(fvalid, owner(fhi) % nici, nici)
+        (r_vec, r_hi, r_lo, r_pd, r_pi, r_lane, r_flags), ovf = exchange(
+            _AXIS, nici, Csend, dest_a,
+            ((svecs, 0, I32), (fhi, _EMPTY, U32), (flo, _EMPTY, U32),
+             (jnp.full((BA,), 0, I32) + dev, -1, I32),
+             (rows_g[flat_b], -1, I32), (flat_a, -1, I32),
+             (flags, 0, I32)))
+        fail = fail | ovf * FAIL_ROUTE
         active = (r_flags & 1) == 1
+        if nslice > 1:
+            dest_b = jnp.where(active, owner(r_hi) // nici, nslice)
+            (r_vec, r_hi, r_lo, r_pd, r_pi, r_lane,
+             r_flags), ovf2 = exchange(
+                _DCN, nslice, Csend2, dest_b,
+                ((r_vec, 0, I32), (r_hi, _EMPTY, U32),
+                 (r_lo, _EMPTY, U32), (r_pd, -1, I32), (r_pi, -1, I32),
+                 (r_lane, -1, I32), (r_flags, 0, I32)))
+            fail = fail | ovf2 * FAIL_ROUTE
+            active = (r_flags & 1) == 1
 
         # ---- owner-side dedup + ring append ----
         tbl_hi, tbl_lo, is_new, pfail = _dedup_insert(
@@ -218,8 +218,8 @@ def _build_segment(config: CheckConfig, caps: PagedShardCapacities, A: int,
         # Ring-lap guard.  Two live regions must never be overwritten: the
         # level window being expanded (from lvl_start) AND the rows not yet
         # paged to the host (from the paged watermark — a mesh device can
-        # receive up to ndev*Csend appends in ONE chunk under routing skew,
-        # far past the between-chunks pause heuristic).  Exact and loud:
+        # receive up to NR appends in ONE chunk under routing skew, far
+        # past the between-chunks pause heuristic).  Exact and loud:
         fail = fail | (n_states + n_new
                        - jnp.minimum(lvl_start, paged_wm) > Rcap) * FAIL_RING
         fail = fail | (n_states > IDX_CEIL) * FAIL_INDEX
@@ -240,9 +240,9 @@ def _build_segment(config: CheckConfig, caps: PagedShardCapacities, A: int,
         else:
             inv_bad = jnp.zeros_like(is_new)
         first = jnp.min(jnp.where(
-            inv_bad, jnp.arange(ndev * Csend, dtype=I32), BIG))
+            inv_bad, jnp.arange(NR, dtype=I32), BIG))
         new_viol = (first < BIG) & (viol_l < 0)
-        fidx = jnp.minimum(first, ndev * Csend - 1)
+        fidx = jnp.minimum(first, NR - 1)
         viol_l = jnp.where(new_viol, pos_st[fidx], viol_l)
         if n_inv:
             bad_inv = jnp.argmax(
@@ -260,11 +260,11 @@ def _build_segment(config: CheckConfig, caps: PagedShardCapacities, A: int,
                 dl, start + jnp.minimum(drow, B - 1), viol_l)
             viol_i = jnp.where(dl, jnp.int32(n_inv), viol_i)
 
-        stop = (jax.lax.psum((viol_l >= 0).astype(I32), _AXIS) > 0) | \
-            (jax.lax.pmax(fail, _AXIS) != 0)
+        stop = (jax.lax.psum((viol_l >= 0).astype(I32), axes) > 0) | \
+            (jax.lax.pmax(fail, axes) != 0)
         # a ring nearing its unpaged rows anywhere -> yield for pageout
         yieldf = jax.lax.pmax(
-            (n_states >= paged_wm + half).astype(I32), _AXIS) > 0
+            (n_states >= paged_wm + half).astype(I32), axes) > 0
         return carry._replace(
             store=store, pdev=pdev, pidx=pidx, lane=lane, conflag=conflag,
             tbl_hi=tbl_hi, tbl_lo=tbl_lo,
@@ -287,7 +287,7 @@ def _build_segment(config: CheckConfig, caps: PagedShardCapacities, A: int,
         steps, carry = jax.lax.while_loop(ccond, cbody, (steps, carry))
         adv = (carry.c >= carry.n_chunks) & ~carry.stop & ~carry.yieldf
         n_new = carry.n_states[0] - carry.lvl_end[0]
-        n_new_tot = jax.lax.psum(n_new, _AXIS)
+        n_new_tot = jax.lax.psum(n_new, axes)
         levels = jnp.where(
             adv,
             carry.levels.at[jnp.minimum(carry.lvl, Lcap - 1)].set(n_new_tot),
@@ -298,9 +298,9 @@ def _build_segment(config: CheckConfig, caps: PagedShardCapacities, A: int,
         lvl_end = jnp.where(adv, carry.n_states[0], carry.lvl_end[0])
         n_act = lvl_end - lvl_start
         n_chunks = jnp.where(
-            adv, jax.lax.pmax((n_act + B - 1) // B, _AXIS), carry.n_chunks)
+            adv, jax.lax.pmax((n_act + B - 1) // B, axes), carry.n_chunks)
         stop = carry.stop | (adv & (n_new_tot == 0)) | \
-            (jax.lax.pmax(fail, _AXIS) != 0)
+            (jax.lax.pmax(fail, axes) != 0)
         return steps, carry._replace(
             levels=levels, fail=fail[None],
             lvl_start=lvl_start[None], lvl_end=lvl_end[None],
@@ -363,12 +363,15 @@ class PagedShardEngine:
             raise ValueError("action table exceeds the link-word field")
         self.seg_chunks = seg_chunks
         self.schema = bitpack.BitSchema(self.bounds)
-        specs = _carry_specs()
+        axes = _mesh_axes(self.mesh)
+        nici = self.mesh.shape[_AXIS]
+        specs = _carry_specs(axes)
         fn = _build_segment(config, self.caps, self.A, self.lay.width,
-                            self.ndev, self.schema)
+                            self.ndev, self.schema, nici=nici, axes=axes)
+        paged_spec = P(axes if len(axes) > 1 else axes[0])
         self._segment = jax.jit(jax.shard_map(
             fn, mesh=self.mesh,
-            in_specs=(specs, P(), P(_AXIS)),
+            in_specs=(specs, P(), paged_spec),
             out_specs=(P(), specs),
             check_vma=False), donate_argnums=(0,))
         self._shardings = jax.tree.map(

@@ -83,6 +83,8 @@ from raft_tla_tpu.utils import pacing
 I32 = jnp.int32
 U32 = jnp.uint32
 _AXIS = "d"     # the frontier/fingerprint mesh axis (DP, SURVEY §2.9)
+_DCN = "dcn"    # outer mesh axis for multi-slice scale-out (SURVEY §2.9
+#                 comm-backend row: ICI within a slice, DCN across slices)
 # routing-buffer overflow (shard engine only; continues the FAIL_* bitmask)
 FAIL_ROUTE = 32
 
@@ -93,13 +95,20 @@ class ShardCapacities:
 
     ``send`` is the per-destination routing buffer depth per chunk; ``None``
     means the safe bound ``chunk * A`` (no overflow possible).  Smaller
-    values trade memory for a loud abort if one chip's candidates concentrate
-    on one owner (hash-uniform, so ~BA/n expected).
+    values trade memory for a loud abort if one chip's candidates
+    concentrate on one destination.  Expected occupancy is hash-uniform
+    over the STAGE-A destination count: ~BA/ndev on a 1-D mesh but
+    ~BA/per_slice on a 2-D mesh (stage A routes within the slice), so a
+    ``send`` tuned on a flat mesh must be rescaled by ndev/per_slice when
+    moving to a slice mesh.  ``send2`` is the stage-B (cross-slice, 2-D
+    only) per-destination-slice depth; ``None`` means the safe bound
+    ``per_slice * send``.
     """
 
     n_states: int = 1 << 17      # store rows per device
     levels: int = 256
     send: Optional[int] = None
+    send2: Optional[int] = None
 
     @property
     def table(self) -> int:      # per-device hash slots, load factor <= 0.5
@@ -116,6 +125,29 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
                 "(tests: --xla_force_host_platform_device_count)")
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (_AXIS,))
+
+
+def make_slice_mesh(n_slices: int, per_slice: int) -> Mesh:
+    """A 2-D ``(dcn, ici)`` mesh: ``n_slices`` pod slices of ``per_slice``
+    chips.  The outer axis rides DCN, the inner ICI; the hierarchical
+    dedup exchange (stage A over ICI, stage B over DCN) keeps cross-slice
+    traffic aggregated into per-slice blocks.  On real multi-slice pods
+    the device order from ``jax.devices()`` groups by slice already; under
+    the virtual CPU mesh the reshape just fixes the flat-id convention
+    ``dev = slice * per_slice + chip``."""
+    devs = jax.devices()
+    if n_slices * per_slice > len(devs):
+        raise ValueError(
+            f"need {n_slices * per_slice} devices, have {len(devs)} "
+            "(tests: --xla_force_host_platform_device_count)")
+    grid = np.asarray(devs[:n_slices * per_slice]).reshape(
+        n_slices, per_slice)
+    return Mesh(grid, (_DCN, _AXIS))
+
+
+def _mesh_axes(mesh: Mesh) -> tuple:
+    """Collective axis names spanning every device of ``mesh``."""
+    return (_DCN, _AXIS) if _DCN in mesh.axis_names else (_AXIS,)
 
 
 class SCarry(NamedTuple):
@@ -153,14 +185,48 @@ _SHARDED = ("store", "parent", "lane", "conflag", "tbl_hi", "tbl_lo",
             "n_trans", "cov", "fail")
 
 
-def _carry_specs():
-    return SCarry(**{f: P(_AXIS) if f in _SHARDED else P()
+def _carry_specs(axes=(_AXIS,)):
+    ax = axes if len(axes) > 1 else axes[0]
+    return SCarry(**{f: P(ax) if f in _SHARDED else P()
                      for f in SCarry._fields})
 
 
+
+def exchange(axis_name, n_dest, cap, dest, payload):
+    """Count-sort ``payload`` rows into per-destination blocks and
+    all_to_all them over one mesh axis (shared by the shard and
+    paged-shard engines; the 2-D hierarchical exchange is two calls —
+    stage A over ICI, stage B over DCN).  ``dest >= n_dest`` drops the
+    row; ``payload`` is a sequence of (values, fill, dtype).  Returns
+    (received payload, overflow flag)."""
+    oh = (dest[:, None] == jnp.arange(n_dest, dtype=I32)[None, :])
+    cum = jnp.cumsum(oh.astype(I32), axis=0)
+    pos = jnp.take_along_axis(
+        cum, jnp.clip(dest, 0, n_dest - 1)[:, None], axis=1)[:, 0] - 1
+    live = dest < n_dest
+    overflow = jnp.any(live & (pos >= cap))
+    slot = jnp.where(live & (pos < cap), dest * cap + pos, n_dest * cap)
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            split_axis=0, concat_axis=0, tiled=True)
+    outs = []
+    for val, fill, dtype in payload:
+        buf = jnp.full((n_dest * cap,) + val.shape[1:], fill, dtype)
+        buf = buf.at[slot].set(val.astype(dtype), mode="drop")
+        outs.append(a2a(buf.reshape((n_dest, cap) + val.shape[1:]))
+                    .reshape((n_dest * cap,) + val.shape[1:]))
+    return outs, overflow
+
+
 def _build_segment(config: CheckConfig, caps: ShardCapacities,
-                   A: int, W: int, ndev: int):
-    """One watchdog-safe slice of the mesh-wide search (<= budget chunks)."""
+                   A: int, W: int, ndev: int, nici: int | None = None,
+                   axes: tuple = (_AXIS,)):
+    """One watchdog-safe slice of the mesh-wide search (<= budget chunks).
+
+    ``nici`` (2-D meshes): devices per slice; the dedup exchange then runs
+    hierarchically — stage A routes candidates over ICI to the owner's
+    in-slice index, stage B forwards them over DCN to the owner's slice in
+    aggregated per-slice blocks (one DCN message per destination slice per
+    chunk instead of per destination chip)."""
     B = config.chunk
     n_inv = len(config.invariants)
     if n_inv > 29:
@@ -169,14 +235,22 @@ def _build_segment(config: CheckConfig, caps: ShardCapacities,
                               tuple(config.invariants), config.symmetry)
     Ncap, Lcap = caps.n_states, caps.levels
     Csend = caps.send if caps.send is not None else B * A
+    nici = ndev if nici is None else nici
+    nslice = ndev // nici
+    Csend2 = caps.send2 if caps.send2 is not None else nici * Csend
+    NR = nici * Csend if ndev // nici == 1 else (ndev // nici) * Csend2
     BIG = jnp.int32(np.iinfo(np.int32).max)
 
     def owner(key_hi):
-        """FP-prefix shard map: which device dedups/stores this state."""
+        """FP shard map: FLAT device id ``slice * nici + chip`` that dedups
+        and stores this state (slice decomposition does not change it, so
+        checkpoints move between 1-D and 2-D meshes of equal size)."""
         return (key_hi % jnp.uint32(ndev)).astype(I32)
 
     def chunk_body(carry: SCarry) -> SCarry:
-        dev = jax.lax.axis_index(_AXIS).astype(I32)
+        dev = jax.lax.axis_index(_AXIS).astype(I32) if nslice == 1 else (
+            jax.lax.axis_index(_DCN).astype(I32) * nici
+            + jax.lax.axis_index(_AXIS).astype(I32))
         lvl_start, lvl_end = carry.lvl_start[0], carry.lvl_end[0]
         n_states, fail = carry.n_states[0], carry.fail[0]
         viol_g, viol_i = carry.viol_g[0], carry.viol_i[0]
@@ -201,14 +275,6 @@ def _build_segment(config: CheckConfig, caps: ShardCapacities,
         fhi = out["fp_hi"].reshape(BA)
         flo = out["fp_lo"].reshape(BA)
         fvalid = valid.reshape(BA)
-        dest = jnp.where(fvalid, owner(fhi), ndev)
-        oh = (dest[:, None] == jnp.arange(ndev, dtype=I32)[None, :])
-        cum = jnp.cumsum(oh.astype(I32), axis=0)
-        pos = jnp.take_along_axis(
-            cum, jnp.clip(dest, 0, ndev - 1)[:, None], axis=1)[:, 0] - 1
-        fail = fail | jnp.any(fvalid & (pos >= Csend)) * FAIL_ROUTE
-        slot = jnp.where(fvalid & (pos < Csend), dest * Csend + pos,
-                         ndev * Csend)
 
         flat_b = jnp.arange(BA, dtype=I32) // A
         flat_a = jnp.arange(BA, dtype=I32) % A
@@ -219,29 +285,30 @@ def _build_segment(config: CheckConfig, caps: ShardCapacities,
             iv = out["inv_ok"].reshape(BA, n_inv).astype(I32)
             flags = flags | jnp.sum(
                 iv << (2 + jnp.arange(n_inv, dtype=I32))[None, :], axis=1)
-
-        def scatter(val, fill, dtype):
-            buf = jnp.full((ndev * Csend,) + val.shape[1:], fill, dtype)
-            return buf.at[slot].set(val.astype(dtype), mode="drop")
-
         svecs = out["svecs"].reshape(BA, W)
-        s_vec = scatter(svecs, 0, I32).reshape(ndev, Csend, W)
-        s_hi = scatter(fhi, _EMPTY, U32).reshape(ndev, Csend)
-        s_lo = scatter(flo, _EMPTY, U32).reshape(ndev, Csend)
-        s_par = scatter(dev * Ncap + gstart + flat_b, -1, I32).reshape(
-            ndev, Csend)
-        s_lane = scatter(flat_a, -1, I32).reshape(ndev, Csend)
-        s_flags = scatter(flags, 0, I32).reshape(ndev, Csend)
+        par_g = dev * Ncap + gstart + flat_b
 
-        a2a = functools.partial(jax.lax.all_to_all, axis_name=_AXIS,
-                                split_axis=0, concat_axis=0, tiled=True)
-        r_vec = a2a(s_vec).reshape(ndev * Csend, W)
-        r_hi = a2a(s_hi).reshape(ndev * Csend)
-        r_lo = a2a(s_lo).reshape(ndev * Csend)
-        r_par = a2a(s_par).reshape(ndev * Csend)
-        r_lane = a2a(s_lane).reshape(ndev * Csend)
-        r_flags = a2a(s_flags).reshape(ndev * Csend)
+        # stage A over ICI: route to the owner's in-slice chip index (for
+        # 1-D meshes nici == ndev and this IS the whole exchange)
+        dest_a = jnp.where(fvalid, owner(fhi) % nici, nici)
+        (r_vec, r_hi, r_lo, r_par, r_lane, r_flags), ovf = exchange(
+            _AXIS, nici, Csend, dest_a,
+            ((svecs, 0, I32), (fhi, _EMPTY, U32), (flo, _EMPTY, U32),
+             (par_g, -1, I32), (flat_a, -1, I32), (flags, 0, I32)))
+        fail = fail | ovf * FAIL_ROUTE
         active = (r_flags & 1) == 1
+        if nslice > 1:
+            # stage B over DCN: every active row already sits on the
+            # owner's chip index; forward to the owner's slice in one
+            # aggregated block per destination slice
+            dest_b = jnp.where(active, owner(r_hi) // nici, nslice)
+            (r_vec, r_hi, r_lo, r_par, r_lane, r_flags), ovf2 = exchange(
+                _DCN, nslice, Csend2, dest_b,
+                ((r_vec, 0, I32), (r_hi, _EMPTY, U32),
+                 (r_lo, _EMPTY, U32), (r_par, -1, I32),
+                 (r_lane, -1, I32), (r_flags, 0, I32)))
+            fail = fail | ovf2 * FAIL_ROUTE
+            active = (r_flags & 1) == 1
 
         # ---- owner-side dedup + append (same protocol as device_engine) ----
         tbl_hi, tbl_lo, is_new, pfail = _dedup_insert(
@@ -265,9 +332,9 @@ def _build_segment(config: CheckConfig, caps: ShardCapacities,
         else:
             inv_bad = jnp.zeros_like(is_new)
         first = jnp.min(jnp.where(
-            inv_bad, jnp.arange(ndev * Csend, dtype=I32), BIG))
+            inv_bad, jnp.arange(NR, dtype=I32), BIG))
         new_viol = (first < BIG) & (viol_g < 0)
-        fidx = jnp.minimum(first, ndev * Csend - 1)
+        fidx = jnp.minimum(first, NR - 1)
         viol_g = jnp.where(new_viol, dev * Ncap + pos_st[fidx], viol_g)
         if n_inv:
             bad_inv = jnp.argmax(
@@ -290,8 +357,8 @@ def _build_segment(config: CheckConfig, caps: ShardCapacities,
             viol_i = jnp.where(dl, jnp.int32(n_inv), viol_i)
 
         # replicated stop flag: any device saw a violation or failed
-        stop = (jax.lax.psum((viol_g >= 0).astype(I32), _AXIS) > 0) | \
-            (jax.lax.pmax(fail, _AXIS) != 0)
+        stop = (jax.lax.psum((viol_g >= 0).astype(I32), axes) > 0) | \
+            (jax.lax.pmax(fail, axes) != 0)
         return carry._replace(
             store=store, parent=parent, lane=lane, conflag=conflag,
             tbl_hi=tbl_hi, tbl_lo=tbl_lo,
@@ -316,7 +383,7 @@ def _build_segment(config: CheckConfig, caps: ShardCapacities,
         # Level advance (lockstep: c/n_chunks/stop are replicated).
         adv = (carry.c >= carry.n_chunks) & ~carry.stop
         n_new = carry.n_states[0] - carry.lvl_end[0]
-        n_new_tot = jax.lax.psum(n_new, _AXIS)
+        n_new_tot = jax.lax.psum(n_new, axes)
         levels = jnp.where(
             adv,
             carry.levels.at[jnp.minimum(carry.lvl, Lcap - 1)].set(n_new_tot),
@@ -327,9 +394,9 @@ def _build_segment(config: CheckConfig, caps: ShardCapacities,
         lvl_end = jnp.where(adv, carry.n_states[0], carry.lvl_end[0])
         n_act = lvl_end - lvl_start
         n_chunks = jnp.where(
-            adv, jax.lax.pmax((n_act + B - 1) // B, _AXIS), carry.n_chunks)
+            adv, jax.lax.pmax((n_act + B - 1) // B, axes), carry.n_chunks)
         stop = carry.stop | (adv & (n_new_tot == 0)) | \
-            (jax.lax.pmax(fail, _AXIS) != 0)
+            (jax.lax.pmax(fail, axes) != 0)
         return steps, carry._replace(
             levels=levels, fail=fail[None],
             lvl_start=lvl_start[None], lvl_end=lvl_end[None],
@@ -386,9 +453,11 @@ class ShardEngine:
                 "exceeds the int32 global-id space (2^31-1); shrink "
                 "ShardCapacities.n_states")
         self.seg_chunks = seg_chunks
-        specs = _carry_specs()
+        axes = _mesh_axes(self.mesh)
+        nici = self.mesh.shape[_AXIS]
+        specs = _carry_specs(axes)
         fn = _build_segment(config, self.caps, self.A, self.lay.width,
-                            self.ndev)
+                            self.ndev, nici=nici, axes=axes)
         self._segment = jax.jit(jax.shard_map(
             fn, mesh=self.mesh, in_specs=(specs, P()),
             out_specs=(P(), specs),

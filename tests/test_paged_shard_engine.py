@@ -125,3 +125,27 @@ def test_symmetry_composes():
     got = PagedShardEngine(cfg, make_mesh(8), CAPS).check()
     assert got.n_states == ref.n_states == 1514     # orbits, not states
     assert got.diameter == ref.diameter
+
+
+def test_slice_mesh_2x4_parity():
+    """2-D (dcn, ici) mesh with the hierarchical two-stage bit-packed
+    exchange: identical exploration metrics to the oracle."""
+    from raft_tla_tpu.config import Bounds, CheckConfig
+    from raft_tla_tpu.models import refbfs
+    from raft_tla_tpu.parallel.paged_shard_engine import (
+        PagedShardCapacities, PagedShardEngine)
+    from raft_tla_tpu.parallel.shard_engine import make_slice_mesh
+
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=64)
+    ref = refbfs.check(cfg)
+    got = PagedShardEngine(cfg, make_slice_mesh(2, 4), PagedShardCapacities(
+        ring=4096, table=1 << 14, levels=64)).check()
+    assert got.n_states == ref.n_states
+    assert got.diameter == ref.diameter
+    assert got.levels == ref.levels
+    assert got.n_transitions == ref.n_transitions
+    assert sum(got.coverage.values()) == sum(ref.coverage.values())
+    assert got.violation is None

@@ -109,3 +109,43 @@ def test_routing_overflow_is_loud():
     caps = ShardCapacities(n_states=1 << 12, levels=64, send=1)
     with pytest.raises(RuntimeError, match="capacity"):
         ShardEngine(cfg, make_mesh(8), caps).check()
+
+
+def test_slice_mesh_2x4_parity():
+    """2-D (dcn, ici) mesh with the hierarchical two-stage exchange
+    explores the identical state graph: same counts, levels, transitions,
+    verdicts as the oracle and (by test_ndev_invariance) the 1-D mesh."""
+    from raft_tla_tpu.parallel.shard_engine import make_slice_mesh
+
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=64)
+    ref = refbfs.check(cfg)
+    got = ShardEngine(cfg, make_slice_mesh(2, 4), CAPS).check()
+    assert got.n_states == ref.n_states
+    assert got.diameter == ref.diameter
+    assert got.levels == ref.levels
+    assert got.n_transitions == ref.n_transitions
+    assert sum(got.coverage.values()) == sum(ref.coverage.values())
+    assert got.violation is None
+
+
+def test_slice_mesh_checkpoint_portable_from_1d(tmp_path):
+    """FP ownership is by FLAT device id, so a 1-D 8-mesh checkpoint
+    resumes on a 2x4 slice mesh (same total size) and finishes with
+    identical counts."""
+    from raft_tla_tpu.parallel.shard_engine import make_slice_mesh
+
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=64)
+    straight = ShardEngine(cfg, make_mesh(8), CAPS).check()
+    ck = str(tmp_path / "flat.ckpt")
+    ShardEngine(cfg, make_mesh(8), CAPS, seg_chunks=8).check(
+        checkpoint=ck, checkpoint_every_s=0.0)
+    got = ShardEngine(cfg, make_slice_mesh(2, 4), CAPS).check(resume=ck)
+    assert got.n_states == straight.n_states
+    assert got.levels == straight.levels
+    assert got.n_transitions == straight.n_transitions
