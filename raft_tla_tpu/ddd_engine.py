@@ -128,22 +128,61 @@ class _DigestCaps:
     levels: int = 1 << 12
 
 
-class SegCarry(NamedTuple):
+class FilterCarry(NamedTuple):
+    """The only serial device state between segments: the lossy filter
+    and the chunk cursor.  Everything else is per-segment output, which
+    is what makes the two-deep segment pipeline possible — segment k+1
+    depends on k only through this carry, so it can be dispatched before
+    k's outputs are harvested."""
+
     tbl_hi: jax.Array     # [TB, BUCKET] lossy filter (donated through)
     tbl_lo: jax.Array
-    okey_hi: jax.Array    # [OCAP] compacted candidate stream (donated) --
+    c: jax.Array          # chunk cursor within the current block
+
+
+class SegBufs(NamedTuple):
+    """One segment's candidate-stream output buffers (donated; the
+    engine ping-pongs two sets so one can transfer/flush on the host
+    while the device fills the other)."""
+
+    okey_hi: jax.Array    # [OCAP]
     okey_lo: jax.Array
     orows: jax.Array      # [OCAP, P] bit-packed successor rows
     opar: jax.Array       # [OCAP] parent discovery index
     olane: jax.Array      # [OCAP] action lane
-    ocon: jax.Array       # [OCAP] constraint flag ------------------------
+    ocon: jax.Array       # [OCAP] constraint flag
+
+
+class SegStats(NamedTuple):
     cursor: jax.Array     # streamed rows this segment (output fill)
     n_valid: jax.Array    # transitions counted (truncated at violation)
     fail: jax.Array       # FAIL_WIDTH bit
     viol_kind: jax.Array  # 0 none / 1 invariant / 2 deadlock
     viol_inv: jax.Array   # invariant index (kind 1)
     dead_g: jax.Array     # kind 2: dead state's discovery index
-    c: jax.Array          # chunk cursor within the current block
+    steps: jax.Array      # chunks executed (pacer signal)
+    done: jax.Array       # block exhausted
+
+
+class _SegCarry(NamedTuple):
+    """Internal while_loop carry (FilterCarry + SegBufs + SegStats
+    scalars)."""
+
+    tbl_hi: jax.Array
+    tbl_lo: jax.Array
+    okey_hi: jax.Array
+    okey_lo: jax.Array
+    orows: jax.Array
+    opar: jax.Array
+    olane: jax.Array
+    ocon: jax.Array
+    cursor: jax.Array
+    n_valid: jax.Array
+    fail: jax.Array
+    viol_kind: jax.Array
+    viol_inv: jax.Array
+    dead_g: jax.Array
+    c: jax.Array
 
 
 def _filter_insert(tbl_hi, tbl_lo, key_hi, key_lo, active):
@@ -203,7 +242,7 @@ def _build_segment(config: CheckConfig, caps: DDDCapacities, A: int,
                               tuple(config.invariants), config.symmetry)
     BIG = jnp.int32(np.iinfo(np.int32).max)
 
-    def chunk_body(carry: SegCarry) -> SegCarry:
+    def chunk_body(carry: _SegCarry) -> _SegCarry:
         (tbl_hi, tbl_lo, okey_hi, okey_lo, orows, opar, olane, ocon,
          cursor, n_valid_a, fail, viol_kind, viol_inv, dead_g, c) = carry
         r0 = c * B
@@ -262,9 +301,9 @@ def _build_segment(config: CheckConfig, caps: DDDCapacities, A: int,
             jnp.minimum(first_inv, N - 1)]) if n_inv else jnp.int32(0)
         dead_g = jnp.where(
             use_dead, block_start + r0 + jnp.minimum(drow, B - 1), dead_g)
-        return SegCarry(tbl_hi, tbl_lo, okey_hi, okey_lo, orows, opar,
-                        olane, ocon, cursor, n_valid_a, fail, viol_kind,
-                        viol_inv_c.astype(I32), dead_g, c + 1)
+        return _SegCarry(tbl_hi, tbl_lo, okey_hi, okey_lo, orows, opar,
+                         olane, ocon, cursor, n_valid_a, fail, viol_kind,
+                         viol_inv_c.astype(I32), dead_g, c + 1)
 
     def cond(sc):
         s, carry = sc
@@ -277,26 +316,29 @@ def _build_segment(config: CheckConfig, caps: DDDCapacities, A: int,
         s, carry = sc
         return s + 1, chunk_body(carry)
 
-    def segment(carry, fbuf_, fcon_, budget_, block_start_, block_rows_):
+    def segment(fc, bufs, fbuf_, fcon_, budget_, block_start_,
+                block_rows_):
         nonlocal fbuf, fcon, budget, block_start, block_rows
         fbuf, fcon = fbuf_, fcon_
         budget = budget_
         block_start, block_rows = block_start_, block_rows_
+        carry = _SegCarry(
+            fc.tbl_hi, fc.tbl_lo, *bufs,
+            cursor=jnp.int32(0), n_valid=jnp.int32(0), fail=jnp.int32(0),
+            viol_kind=jnp.int32(0), viol_inv=jnp.int32(0),
+            dead_g=jnp.int32(-1), c=fc.c)
         steps, carry = jax.lax.while_loop(cond, body,
                                           (jnp.int32(0), carry))
         n_chunks = (block_rows + B - 1) // B
-        return steps, carry.c >= n_chunks, carry
+        return (FilterCarry(carry.tbl_hi, carry.tbl_lo, carry.c),
+                SegBufs(carry.okey_hi, carry.okey_lo, carry.orows,
+                        carry.opar, carry.olane, carry.ocon),
+                SegStats(carry.cursor, carry.n_valid, carry.fail,
+                         carry.viol_kind, carry.viol_inv, carry.dead_g,
+                         steps, carry.c >= n_chunks))
 
     fbuf = fcon = budget = block_start = block_rows = None
     return segment
-
-
-@functools.lru_cache(maxsize=64)
-def _slicer(k: int):
-    """Jitted prefix-slice so d2h transfers only ~n_stream rows; cached
-    per padded size (sizes are rounded to powers of two, so at most
-    log2(N) programs compile per engine)."""
-    return jax.jit(lambda *arrs: tuple(a[:k] for a in arrs))
 
 
 class DDDEngine:
@@ -326,23 +368,24 @@ class DDDEngine:
         self._segment = jax.jit(
             _build_segment(config, self.caps, self.A, self.lay.width,
                            self.schema),
-            donate_argnums=(0,))
+            donate_argnums=(0, 1))
 
-    def _init_segcarry(self) -> SegCarry:
+    def _init_filter(self) -> FilterCarry:
         TB = self.caps.table // BUCKET
-        OCAP = self.caps.seg_rows
-        return SegCarry(
+        return FilterCarry(
             tbl_hi=jnp.full((TB, BUCKET), _EMPTY, U32),
             tbl_lo=jnp.full((TB, BUCKET), _EMPTY, U32),
+            c=jnp.int32(0))
+
+    def _make_bufs(self) -> SegBufs:
+        OCAP = self.caps.seg_rows
+        return SegBufs(
             okey_hi=jnp.zeros((OCAP,), U32),
             okey_lo=jnp.zeros((OCAP,), U32),
             orows=jnp.zeros((OCAP, self.schema.P), I32),
             opar=jnp.zeros((OCAP,), I32),
             olane=jnp.zeros((OCAP,), I32),
-            ocon=jnp.zeros((OCAP,), bool),
-            cursor=jnp.int32(0), n_valid=jnp.int32(0),
-            fail=jnp.int32(0), viol_kind=jnp.int32(0),
-            viol_inv=jnp.int32(0), dead_g=jnp.int32(-1), c=jnp.int32(0))
+            ocon=jnp.zeros((OCAP,), bool))
 
     # -- host dedup -----------------------------------------------------
 
@@ -499,11 +542,11 @@ class DDDEngine:
             level_ends = [1]
             blocks_done = 0
 
-        carry = self._init_segcarry()           # filter ≠ correctness:
+        fc = self._init_filter()                # filter ≠ correctness:
+        bufsets = [self._make_bufs(), self._make_bufs()]
         pend = {"keys": [], "rows": [], "par": [],  # resume starts empty
                 "lane": [], "con": []}
         Fcap = self.caps.block
-        OCAP = self.caps.seg_rows
         viol = None          # (kind, inv_idx, dead_g) once detected
         viol_key = None
         fail = 0
@@ -547,55 +590,86 @@ class DDDEngine:
                         [con, np.zeros((Fcap - b_rows,), bool)])
                 fbuf = jnp.asarray(blk)
                 fcon = jnp.asarray(con)
-                carry = carry._replace(c=jnp.int32(0))
+                fc = fc._replace(c=jnp.int32(0))
+                # Two-deep segment pipeline: segment k+1 depends on k only
+                # through the filter carry, so it is dispatched BEFORE k's
+                # outputs are harvested — the d2h transfer and the host
+                # dedup flush overlap device compute (the PP overlap the
+                # round-1 verdict called out).  Dispatch order == harvest
+                # order == stream order, so every exactness argument is
+                # unchanged.  A segment dispatched speculatively after the
+                # block's last chunk runs zero chunks (its while_loop cond
+                # fails immediately); one harvested AFTER a stop event
+                # (violation/failure/deadline) is dropped whole — its work
+                # lies beyond the refbfs-exact stop point, and its filter
+                # insertions are harmless (the run is over; resume
+                # rebuilds the filter empty).
+                q = []               # in-flight: (bufset idx, stats, t)
+                free = list(range(len(bufsets)))
                 block_done = False
-                while not block_done:
-                    if (deadline_s is not None and t_warm is not None
+                t_last_harvest = time.monotonic()
+                while q or not (block_done or stopped):
+                    if (not stopped and deadline_s is not None
+                            and t_warm is not None
                             and time.monotonic() - t_warm > deadline_s):
                         complete = False
                         stopped = True
-                        break
-                    t_seg = time.monotonic()
-                    steps_d, done_d, carry = self._segment(
-                        carry, fbuf, fcon, jnp.int32(budget),
-                        jnp.int32(b_start), jnp.int32(b_rows))
-                    (ns, nv, fl, vk) = map(int, jax.device_get(
-                        (carry.cursor, carry.n_valid, carry.fail,
-                         carry.viol_kind)))
+                    if not (block_done or stopped) and free:
+                        idx = free.pop(0)
+                        t_disp = time.monotonic()
+                        fc, bufsets[idx], stats = self._segment(
+                            fc, bufsets[idx], fbuf, fcon,
+                            jnp.int32(budget), jnp.int32(b_start),
+                            jnp.int32(b_rows))
+                        q.append((idx, stats, t_disp))
+                        if len(q) < 2:
+                            continue         # keep the pipeline full
+                    if not q:                # stop landed with nothing
+                        break                # in flight
+                    idx, stats, t_disp = q.pop(0)
+                    st_h, bufs_h = jax.device_get((stats, bufsets[idx]))
+                    free.append(idx)
+                    if stopped:
+                        continue             # drop post-stop segments
+                    ns, nv = int(st_h.cursor), int(st_h.n_valid)
+                    vk = int(st_h.viol_kind)
                     n_trans += nv
-                    fail |= fl
+                    fail |= int(st_h.fail)
                     if ns:
-                        k = max(1024, 1 << (ns - 1).bit_length())
-                        kh, kl, rws, par, lan, cn = jax.device_get(
-                            _slicer(min(k, OCAP))(
-                                carry.okey_hi, carry.okey_lo, carry.orows,
-                                carry.opar, carry.olane, carry.ocon))
-                        pend["keys"].append(
-                            keyset.pack_keys(kh[:ns], kl[:ns]))
-                        pend["rows"].append(rws[:ns])
-                        pend["par"].append(par[:ns])
-                        pend["lane"].append(lan[:ns])
-                        pend["con"].append(cn[:ns])
-                    carry = carry._replace(cursor=jnp.int32(0),
-                                           n_valid=jnp.int32(0))
+                        # .copy(): a bare slice would pin the whole OCAP
+                        # transfer buffer in pend until the next flush
+                        pend["keys"].append(keyset.pack_keys(
+                            bufs_h.okey_hi[:ns], bufs_h.okey_lo[:ns]))
+                        pend["rows"].append(bufs_h.orows[:ns].copy())
+                        pend["par"].append(bufs_h.opar[:ns].copy())
+                        pend["lane"].append(bufs_h.olane[:ns].copy())
+                        pend["con"].append(bufs_h.ocon[:ns].copy())
                     if vk or fail:
                         if vk:
-                            vi, dg = map(int, jax.device_get(
-                                (carry.viol_inv, carry.dead_g)))
-                            viol = (vk, vi, dg)
+                            viol = (vk, int(st_h.viol_inv),
+                                    int(st_h.dead_g))
                             if vk == 1:
                                 # truncation makes the violator the last
                                 # streamed candidate; remember its key to
                                 # assert the flushed identity below
                                 viol_key = pend["keys"][-1][-1]
                         stopped = True
-                        break
-                    dt = time.monotonic() - t_seg
+                        continue
+                    now = time.monotonic()
                     if t_warm is None:
-                        t_warm = time.monotonic()
-                    budget = pacer.update(dt, max(1, int(steps_d)))
+                        t_warm = now
+                    # own device time ~ since the later of my dispatch
+                    # and the previous harvest (queue wait excluded); a
+                    # zero-chunk speculative segment (block already done)
+                    # is pure transfer time — no pacing signal, and it
+                    # would poison the watchdog ratchet
+                    if int(st_h.steps) > 0:
+                        budget = pacer.update(
+                            now - max(t_disp, t_last_harvest),
+                            int(st_h.steps))
+                    t_last_harvest = now
                     self.seg_chunks = budget
-                    block_done = bool(done_d)
+                    block_done = block_done or bool(st_h.done)
                     if sum(len(x) for x in pend["keys"]) >= \
                             self.caps.flush:
                         n_states += self._flush(pend, master, host,
@@ -603,7 +677,6 @@ class DDDEngine:
                         if n_states > _IDX_CEIL:
                             fail = FAIL_INDEX
                             stopped = True
-                            break
                         progress()
                 if stopped:
                     break

@@ -176,3 +176,18 @@ def test_masterkeys_unit():
     # strictly-new flush merges in order
     assert m.dedup(np.array([2, 1, 2], np.uint64)).tolist() == [0, 1]
     assert m.array.tolist() == [1, 2, 3, 7, 9, 11]
+
+
+def test_deadline_stops_cleanly():
+    """A deadline expiry — including one landing between blocks with an
+    empty pipeline — returns complete=False instead of crashing, and the
+    partial counts stay self-consistent."""
+    cfg = CheckConfig(bounds=Bounds(n_servers=3, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=1),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=64)
+    caps = DDDCapacities(block=256, table=1 << 14, flush=1 << 9, levels=64)
+    got = DDDEngine(cfg, caps).check(deadline_s=0.5)
+    assert not got.complete
+    assert 1 <= got.n_states < 142538
+    assert got.violation is None
