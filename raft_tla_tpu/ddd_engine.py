@@ -627,12 +627,24 @@ class DDDEngine:
                     if not q:                # stop landed with nothing
                         break                # in flight
                     idx, stats, t_disp = q.pop(0)
-                    st_h, bufs_h = jax.device_get((stats, bufsets[idx]))
+                    # Stats first (tiny); the OCAP-sized buffers transfer
+                    # only when the segment streamed anything.  The full-
+                    # buffer transfer (vs the old jitted prefix slice) is
+                    # deliberate: a slice program would enqueue BEHIND the
+                    # in-flight speculative segment on the serial device
+                    # queue and stall the harvest until it finishes —
+                    # defeating the overlap this pipeline exists for.  At
+                    # the 8 s segment target the fixed transfer is a few
+                    # percent; zero-stream segments (every block end) now
+                    # skip it entirely.
+                    st_h = jax.device_get(stats)
+                    ns, nv = int(st_h.cursor), int(st_h.n_valid)
+                    vk = int(st_h.viol_kind)
+                    bufs_h = jax.device_get(bufsets[idx]) \
+                        if ns and not stopped else None
                     free.append(idx)
                     if stopped:
                         continue             # drop post-stop segments
-                    ns, nv = int(st_h.cursor), int(st_h.n_valid)
-                    vk = int(st_h.viol_kind)
                     n_trans += nv
                     fail |= int(st_h.fail)
                     if ns:
@@ -678,6 +690,10 @@ class DDDEngine:
                             fail = FAIL_INDEX
                             stopped = True
                         progress()
+                        # the flush ran while the next segment computed;
+                        # re-stamp so its duration never inflates the next
+                        # harvest's dt (the pacer ratchet never decays)
+                        t_last_harvest = time.monotonic()
                 if stopped:
                     break
                 blocks_done += 1

@@ -32,6 +32,7 @@ prefixes.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -535,15 +536,28 @@ def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
     orbit_fp = sym.build_orbit_fp(bounds, symmetry, consts,
                                   "allLogs" in lay.shapes) \
         if symmetry else None
+    # VMEM-resident Pallas orbit kernel (ops/pallas_orbit.py): HBM reads
+    # each candidate once instead of once per group element.  Opt-in via
+    # RAFT_TLA_PALLAS_ORBIT=1 (bit-identical keys — tests/
+    # test_pallas_orbit.py — so checkpoints carry across the switch);
+    # covers Server-only parity mode, else falls back to the scan path.
+    pallas_orbit_fp = None
+    if symmetry and os.environ.get("RAFT_TLA_PALLAS_ORBIT", "0") == "1":
+        from raft_tla_tpu.ops import pallas_orbit
+        pallas_orbit_fp = pallas_orbit.build_orbit_fp(
+            bounds, symmetry, "allLogs" in lay.shapes)
 
     def step(vecs):
         structs = jax.vmap(lambda v: st.unpack(v, lay, jnp))(vecs)
         succs, valid, ovf = jax.vmap(expand)(structs)
         svecs = jax.vmap(jax.vmap(lambda t: st.pack(t, jnp)))(succs)
         if symmetry:
-            flat = jax.tree.map(
-                lambda a: a.reshape((-1,) + a.shape[2:]), succs)
-            fh, fl = orbit_fp(flat)
+            if pallas_orbit_fp is not None:
+                fh, fl = pallas_orbit_fp(svecs.reshape(-1, lay.width))
+            else:
+                flat = jax.tree.map(
+                    lambda a: a.reshape((-1,) + a.shape[2:]), succs)
+                fh, fl = orbit_fp(flat)
             fp_hi = fh.reshape(svecs.shape[:2])
             fp_lo = fl.reshape(svecs.shape[:2])
         else:

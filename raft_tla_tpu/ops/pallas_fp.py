@@ -30,9 +30,29 @@ _BLOCK_ROWS = 1024
 _LANES = 128          # TPU lane width; W pads up to a multiple
 
 
+def i32_const(u) -> int:
+    """uint32 constant as the same-bits PYTHON int32 literal (shared by
+    the Pallas kernels: they may not close over traced array constants,
+    and plain ints fold into the program)."""
+    return int(np.uint32(u).astype(np.int32))
+
+
 def _i32(u) -> jnp.int32:
     """Reinterpret a uint32 constant as int32 (same bits)."""
     return jnp.int32(np.uint32(u).astype(np.int32))
+
+
+def fmix_i32(h):
+    """murmur3 finalizer in two's-complement int32 — bit-identical to
+    the uint32 reference (ops/fingerprint._fmix32); right shifts are
+    explicitly logical.  Shared by both Pallas kernels."""
+    srl = jax.lax.shift_right_logical
+    h = h ^ srl(h, 16)
+    h = h * i32_const(0x85EBCA6B)
+    h = h ^ srl(h, 13)
+    h = h * i32_const(0xC2B2AE35)
+    h = h ^ srl(h, 16)
+    return h
 
 
 def _fp_kernel(vec_ref, c1_ref, c2_ref, hi_ref, lo_ref):

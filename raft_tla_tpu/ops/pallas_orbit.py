@@ -1,0 +1,266 @@
+"""Pallas TPU kernel for the orbit-minimal fingerprint (Server symmetry).
+
+The scan-compiled orbit pass (ops/symmetry.build_orbit_fp) is the hot
+stage of every symmetric search: at 5 servers it iterates the permute →
+canonicalize → pack → fingerprint pipeline 120 times per candidate
+block, and under ``lax.scan`` XLA materializes the intermediate struct in
+HBM on EVERY iteration — measured ~123 ms of a 134 ms chunk at
+chunk 2048, ~100x off both the VPU and HBM rooflines (RESULTS.md
+round-2 profile).  This kernel keeps a row block resident in VMEM and
+unrolls the whole permutation group over it, so HBM sees each candidate
+exactly once: read [R, W] lanes, write [R] (hi, lo).
+
+The key algebraic move: a permutation only REORDERS most lanes, and the
+fingerprint is a dot product — so instead of gathering the data, the
+kernel dots the ORIGINAL lanes against **permutation-permuted constants**
+(``sum_l v[g[l]]*c[l] == sum_m v[m]*c[ginv[m]]``), baked per group
+element into one ``[P, 2, W]`` operand.  Only the three value-rewriting
+fields (votedFor relabel, vote-bitmask bit moves, message src/dst
+relabel + slot re-sort) are computed explicitly, with static integer
+slices and short one-hot sums — no tables, no dynamic gathers, no
+captured constants.
+
+Scope: **parity mode** (no history variables), **Server axis only** —
+the shape of every large campaign (the flagship, elect5, config #4).
+Value symmetry / faithful mode fall back to the scan path in
+kernels.build_step.
+
+Bit-identity with the scan path (asserted lane-for-lane in
+tests/test_pallas_orbit.py):
+
+- canonicalize re-sorts the S message slots with the same odd-even
+  comparator network as ``state._network_sort`` (the sorted result is
+  unique, see its docstring); hi/lo stay below 2^31 (ops/msgbits field
+  widths), so int32 comparisons equal the reference's;
+- the fingerprint runs in two's-complement int32, bit-identical to
+  uint32 mod 2^32 (the ops/pallas_fp.py argument), with explicitly
+  logical right shifts in the finalizer;
+- the (hi, lo) running min uses sign-bias-corrected comparisons, since
+  the reference minimizes in uint32.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.ops import fingerprint as fpr
+from raft_tla_tpu.ops import msgbits as mb
+from raft_tla_tpu.ops.pallas_fp import fmix_i32, i32_const
+from raft_tla_tpu.ops import state as st
+from raft_tla_tpu.ops import symmetry as sym
+
+_BLOCK_ROWS = 256
+
+# fields whose VALUES change under a server relabeling (everything else
+# only moves between lanes, which the permuted-constants trick absorbs)
+_REWRITTEN = ("votedFor", "vResp", "vGrant", "msgHi", "msgLo", "msgCount")
+
+
+def _offsets(lay: st.Layout) -> dict:
+    out, off = {}, 0
+    for f, shape in lay.shapes.items():
+        out[f] = (off, shape)
+        off += int(np.prod(shape))
+    return out
+
+
+def _perm_gather_index(lay: st.Layout, p: tuple) -> np.ndarray:
+    """Static lane map for one permutation: output lane w reads input
+    lane ``gidx[w]`` — the pure-reorder part of ``permute_struct``
+    (``rows(a) = take(a, inv, axis=1)`` with ``inv[k] = p.index(k)``)."""
+    n, L = lay.n, lay.L
+    inv = [p.index(k) for k in range(n)]
+    offs = _offsets(lay)
+    gidx = np.arange(lay.width, dtype=np.int32)
+    for f in ("role", "term", "votedFor", "commitIndex", "logLen",
+              "vResp", "vGrant"):
+        b = offs[f][0]
+        for k in range(n):
+            gidx[b + k] = b + inv[k]
+    for f in ("logTerm", "logVal"):
+        b = offs[f][0]
+        for k in range(n):
+            for l in range(L):
+                gidx[b + k * L + l] = b + inv[k] * L + l
+    for f in ("nextIndex", "matchIndex"):
+        b = offs[f][0]
+        for k in range(n):
+            for l in range(n):
+                gidx[b + k * n + l] = b + inv[k] * n + inv[l]
+    return gidx
+
+
+def _perm_consts(lay: st.Layout, consts: np.ndarray,
+                 perms: tuple) -> np.ndarray:
+    """``cp[pi, t, m] = consts[t, ginv_pi[m]]`` on reorder-only lanes,
+    0 on value-rewritten lanes (their contributions are added explicitly
+    in the kernel)."""
+    offs = _offsets(lay)
+    rewritten = np.zeros(lay.width, bool)
+    for f in _REWRITTEN:
+        b, shape = offs[f]
+        rewritten[b:b + int(np.prod(shape))] = True
+    ci = consts.astype(np.uint32).view(np.int32)
+    cp = np.zeros((len(perms), 2, lay.width), np.int32)
+    for pi, p in enumerate(perms):
+        ginv = np.argsort(_perm_gather_index(lay, p))
+        for t in range(2):
+            row = ci[t][ginv].copy()
+            row[rewritten] = 0
+            cp[pi, t] = row
+    return cp
+
+
+def _build_kernel(bounds: Bounds):
+    lay = st.Layout.of(bounds)
+    n, S = lay.n, lay.S
+    offs = _offsets(lay)
+    perms = sym.permutations(bounds)
+    pairs = st._oddeven_pairs(S)
+    s_sh, s_w = mb._HI_FIELDS["src"]
+    d_sh, d_w = mb._HI_FIELDS["dst"]
+    hi_keep = int(~np.int32(((1 << s_w) - 1) << s_sh
+                            | ((1 << d_w) - 1) << d_sh))
+    b_vf = offs["votedFor"][0]
+    b_vr = offs["vResp"][0]
+    b_vg = offs["vGrant"][0]
+    b_mh = offs["msgHi"][0]
+    b_ml = offs["msgLo"][0]
+    b_mc = offs["msgCount"][0]
+    SIGN = i32_const(0x80000000)
+
+    def kernel(vec_ref, cp_ref, cr_ref, hi_ref, lo_ref):
+        w0 = vec_ref[...]                       # [R, W] VMEM-resident
+        R = w0.shape[0]
+        best_hi = jnp.full((R,), -1, jnp.int32)     # 0xFFFFFFFF
+        best_lo = jnp.full((R,), -1, jnp.int32)
+        for pi, p in enumerate(perms):
+            inv = [p.index(k) for k in range(n)]
+            # reorder-only lanes: dot against permuted constants
+            s1 = jnp.sum(w0 * cp_ref[pi, 0][None, :], axis=1,
+                         dtype=jnp.int32)
+            s2 = jnp.sum(w0 * cp_ref[pi, 1][None, :], axis=1,
+                         dtype=jnp.int32)
+
+            def add(s1, s2, col, lane):
+                return (s1 + col * cr_ref[0, lane],
+                        s2 + col * cr_ref[1, lane])
+
+            # votedFor: column k comes from old column inv[k]; values
+            # relabel 0 (Nil) fixed, j+1 -> p[j]+1
+            for k in range(n):
+                col = w0[:, b_vf + inv[k]]
+                col2 = jnp.zeros_like(col)
+                for j in range(n):
+                    col2 = col2 + jnp.where(col == j + 1,
+                                            jnp.int32(p[j] + 1), 0)
+                s1, s2 = add(s1, s2, col2, b_vf + k)
+            # vote bitmasks: bit j moves to bit p[j]
+            for base in (b_vr, b_vg):
+                for k in range(n):
+                    col = w0[:, base + inv[k]]
+                    col2 = jnp.zeros_like(col)
+                    for j in range(n):
+                        col2 = col2 | (((col >> j) & 1) << p[j])
+                    s1, s2 = add(s1, s2, col2, base + k)
+            # message slots: src/dst relabel on occupied slots, zero the
+            # unoccupied, then the canonical odd-even slot sort
+            ks, hs, ls, cs = [], [], [], []
+            for s in range(S):
+                hi = w0[:, b_mh + s]
+                lo = w0[:, b_ml + s]
+                ct = w0[:, b_mc + s]
+                src = (hi >> s_sh) & ((1 << s_w) - 1)
+                dst = (hi >> d_sh) & ((1 << d_w) - 1)
+                src2 = jnp.zeros_like(src)
+                dst2 = jnp.zeros_like(dst)
+                for j in range(n):
+                    src2 = src2 + jnp.where(src == j, jnp.int32(p[j]), 0)
+                    dst2 = dst2 + jnp.where(dst == j, jnp.int32(p[j]), 0)
+                occ = ct > 0
+                hi = jnp.where(occ, (hi & hi_keep) | (src2 << s_sh)
+                               | (dst2 << d_sh), 0)
+                lo = jnp.where(occ, lo, 0)
+                ct = jnp.where(occ, ct, 0)
+                ks.append((~occ).astype(jnp.int32))
+                hs.append(hi)
+                ls.append(lo)
+                cs.append(ct)
+            for i, j in pairs:
+                le = ls[i] <= ls[j]
+                le = (hs[i] < hs[j]) | ((hs[i] == hs[j]) & le)
+                le = (ks[i] < ks[j]) | ((ks[i] == ks[j]) & le)
+                for arr in (ks, hs, ls, cs):
+                    a, b = arr[i], arr[j]
+                    arr[i] = jnp.where(le, a, b)
+                    arr[j] = jnp.where(le, b, a)
+            for s in range(S):
+                s1, s2 = add(s1, s2, hs[s], b_mh + s)
+                s1, s2 = add(s1, s2, ls[s], b_ml + s)
+                s1, s2 = add(s1, s2, cs[s], b_mc + s)
+
+            fhi = fmix_i32(s1 + i32_const(int(fpr._LANE_SEEDS[0])))
+            flo = fmix_i32(s2 + i32_const(int(fpr._LANE_SEEDS[1])))
+            # unsigned (hi, lo) lexicographic min via sign bias
+            bh, bl = best_hi ^ SIGN, best_lo ^ SIGN
+            fh, fl = fhi ^ SIGN, flo ^ SIGN
+            take = (fh < bh) | ((fh == bh) & (fl < bl))
+            best_hi = jnp.where(take, fhi, best_hi)
+            best_lo = jnp.where(take, flo, best_lo)
+        hi_ref[...] = best_hi
+        lo_ref[...] = best_lo
+
+    return kernel, lay.width, perms
+
+
+def supported(bounds: Bounds, axes: tuple, faithful: bool) -> bool:
+    return tuple(axes) == ("Server",) and not faithful
+
+
+@functools.partial(jax.jit, static_argnames=("bounds", "interpret"))
+def _orbit_call(vecs, bounds, interpret=False):
+    kernel, W, perms = _build_kernel(bounds)
+    consts = fpr.lane_constants(W)
+    lay = st.Layout.of(bounds)
+    cp = jnp.asarray(_perm_consts(lay, consts, perms))
+    cr = jnp.asarray(consts.astype(np.uint32).view(np.int32))
+    N = vecs.shape[0]
+    R = _BLOCK_ROWS
+    npad = (-N) % R
+    v = jnp.pad(vecs, ((0, npad), (0, 0)))
+    grid = (v.shape[0] // R,)
+    P = len(perms)
+    hi, lo = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((R, W), lambda i: (i, 0)),
+                  pl.BlockSpec((P, 2, W), lambda i: (0, 0, 0)),
+                  pl.BlockSpec((2, W), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((R,), lambda i: (i,)),
+                   pl.BlockSpec((R,), lambda i: (i,))],
+        out_shape=[jax.ShapeDtypeStruct((v.shape[0],), jnp.int32),
+                   jax.ShapeDtypeStruct((v.shape[0],), jnp.int32)],
+        interpret=interpret,
+    )(v.astype(jnp.int32), cp, cr)
+    return (hi[:N].astype(jnp.uint32), lo[:N].astype(jnp.uint32))
+
+
+def build_orbit_fp(bounds: Bounds, axes: tuple, faithful: bool,
+                   interpret: bool | None = None):
+    """Packed-vec orbit fingerprints ``vecs[N, W] -> (hi, lo)[N]``, or
+    ``None`` when this kernel does not cover the configuration."""
+    if not supported(bounds, axes, faithful):
+        return None
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def orbit_fp(vecs):
+        return _orbit_call(vecs, bounds, interpret)
+
+    return orbit_fp

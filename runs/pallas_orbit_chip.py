@@ -1,0 +1,79 @@
+"""Real-chip validation + benchmark of the Pallas orbit kernel.
+
+1. Bit-identity: scan path vs Pallas kernel on random domain states at
+   3s and 5s bounds (compiled, not interpret).
+2. Throughput: the 5-server election step (the elect5/config-#4 shape)
+   with and without RAFT_TLA_PALLAS_ORBIT, warm, chunk 4096.
+
+Run ONLY while no campaign owns the chip (one engine per process).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.ops import fingerprint as fpr
+from raft_tla_tpu.ops import pallas_orbit
+from raft_tla_tpu.ops import state as st
+from raft_tla_tpu.ops import symmetry as sym
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests"))
+from test_pallas_orbit import pack_batch, random_struct  # noqa: E402
+
+
+def check_bounds(bounds, N=4096):
+    rng = np.random.default_rng(11)
+    struct = random_struct(bounds, N, rng)
+    lay = st.Layout.of(bounds)
+    consts = jnp.asarray(fpr.lane_constants(lay.width))
+    ref_fn = jax.jit(sym.build_orbit_fp(bounds, ("Server",), consts,
+                                        False))
+    pal_fn = pallas_orbit.build_orbit_fp(bounds, ("Server",), False,
+                                         interpret=False)
+    js = {k: jnp.asarray(v) for k, v in struct.items()}
+    vecs = jnp.asarray(pack_batch(struct, lay))
+
+    t0 = time.monotonic()
+    rh, rl = jax.device_get(ref_fn(js))
+    t_ref_cold = time.monotonic() - t0
+    t0 = time.monotonic()
+    ph, pl_ = jax.device_get(pal_fn(vecs))
+    t_pal_cold = time.monotonic() - t0
+    assert (rh == ph).all() and (rl == pl_).all(), "BIT MISMATCH"
+
+    reps = 20
+    t0 = time.monotonic()
+    for _ in range(reps):
+        out = ref_fn(js)
+    jax.block_until_ready(out)
+    t_ref = (time.monotonic() - t0) / reps
+    t0 = time.monotonic()
+    for _ in range(reps):
+        out = pal_fn(vecs)
+    jax.block_until_ready(out)
+    t_pal = (time.monotonic() - t0) / reps
+    print(f"{bounds.n_servers}s: bit-identical on {N} rows; warm "
+          f"scan {t_ref*1e3:.1f} ms vs pallas {t_pal*1e3:.1f} ms "
+          f"({t_ref/t_pal:.1f}x); cold {t_ref_cold:.1f}/"
+          f"{t_pal_cold:.1f} s")
+
+
+def main():
+    print("devices:", jax.devices())
+    check_bounds(Bounds(n_servers=3, n_values=2, max_term=2, max_log=1,
+                        max_msgs=2, max_dup=1))
+    check_bounds(Bounds(n_servers=5, n_values=2, max_term=2, max_log=0,
+                        max_msgs=2, max_dup=1))
+
+
+if __name__ == "__main__":
+    main()

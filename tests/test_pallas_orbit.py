@@ -1,0 +1,108 @@
+"""Pallas orbit-fingerprint kernel ≡ the scan-compiled reference
+(ops/pallas_orbit.py vs ops/symmetry.build_orbit_fp), lane-for-lane.
+
+Runs the kernel in interpret mode on CPU (the pallas_fp.py pattern); the
+same program compiles for TPU, where it replaces the scan path in
+kernels.build_step when enabled.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.ops import fingerprint as fpr
+from raft_tla_tpu.ops import msgbits as mb
+from raft_tla_tpu.ops import pallas_orbit
+from raft_tla_tpu.ops import state as st
+from raft_tla_tpu.ops import symmetry as sym
+
+# CI (CPU interpret mode) covers 2 and 3 servers — every code path, two
+# layouts.  The 5-server instance (120 unrolled permutations) takes ~1 h
+# in interpret mode, so it is exercised COMPILED on the real chip by
+# runs/pallas_orbit_chip.py instead (bit-identity + throughput), which
+# must be re-run whenever this kernel changes.
+BOUNDS = (
+    Bounds(n_servers=2, n_values=1, max_term=2, max_log=0, max_msgs=2),
+    Bounds(n_servers=3, n_values=2, max_term=2, max_log=1, max_msgs=2,
+           max_dup=1),
+)
+
+
+def random_struct(bounds, N, rng):
+    """Domain-respecting random states (not necessarily reachable — the
+    orbit key is defined on the whole encoding domain)."""
+    lay = st.Layout.of(bounds)
+    n, L, S = lay.n, lay.L, lay.S
+    occ = rng.integers(0, 2, (N, S)).astype(bool)
+    hi = rng.integers(0, 1 << 29, (N, S), dtype=np.int64).astype(np.int32)
+    lo = rng.integers(0, 1 << 31, (N, S), dtype=np.int64).astype(np.int32)
+    ct = rng.integers(1, max(2, bounds.max_dup + 1), (N, S))
+    return {
+        "role": rng.integers(0, 3, (N, n)).astype(np.int32),
+        "term": rng.integers(0, bounds.max_term + 1, (N, n)).astype(
+            np.int32),
+        "votedFor": rng.integers(0, n + 1, (N, n)).astype(np.int32),
+        "commitIndex": rng.integers(0, L + 1, (N, n)).astype(np.int32),
+        "logLen": rng.integers(0, L + 1, (N, n)).astype(np.int32),
+        "logTerm": rng.integers(0, bounds.max_term + 1,
+                                (N, n, L)).astype(np.int32),
+        "logVal": rng.integers(0, bounds.n_values + 1,
+                               (N, n, L)).astype(np.int32),
+        "vResp": rng.integers(0, 1 << n, (N, n)).astype(np.int32),
+        "vGrant": rng.integers(0, 1 << n, (N, n)).astype(np.int32),
+        "nextIndex": rng.integers(1, L + 2, (N, n, n)).astype(np.int32),
+        "matchIndex": rng.integers(0, L + 1, (N, n, n)).astype(np.int32),
+        "msgHi": np.where(occ, hi, 0).astype(np.int32),
+        "msgLo": np.where(occ, lo, 0).astype(np.int32),
+        "msgCount": np.where(occ, ct, 0).astype(np.int32),
+    }
+
+
+def pack_batch(struct, lay):
+    return np.concatenate(
+        [np.asarray(struct[f]).reshape(len(struct["role"]), -1)
+         for f in lay.fields], axis=1).astype(np.int32)
+
+
+@pytest.mark.parametrize("bounds", BOUNDS,
+                         ids=[f"{b.n_servers}s" for b in BOUNDS])
+def test_bit_identical_to_scan_reference(bounds):
+    rng = np.random.default_rng(7)
+    N = 96 if bounds.n_servers == 5 else 256
+    struct = random_struct(bounds, N, rng)
+    lay = st.Layout.of(bounds)
+    consts = jnp.asarray(fpr.lane_constants(lay.width))
+    ref_fn = sym.build_orbit_fp(bounds, ("Server",), consts, False)
+    ref_hi, ref_lo = jax.jit(ref_fn)(
+        {k: jnp.asarray(v) for k, v in struct.items()})
+    fn = pallas_orbit.build_orbit_fp(bounds, ("Server",), False,
+                                     interpret=True)
+    got_hi, got_lo = fn(jnp.asarray(pack_batch(struct, lay)))
+    np.testing.assert_array_equal(np.asarray(got_hi), np.asarray(ref_hi))
+    np.testing.assert_array_equal(np.asarray(got_lo), np.asarray(ref_lo))
+
+
+def test_unsupported_configs_fall_back():
+    b = BOUNDS[0]
+    assert pallas_orbit.build_orbit_fp(b, ("Server", "Value"), False) \
+        is None
+    assert pallas_orbit.build_orbit_fp(b, ("Server",), True) is None
+
+
+def test_matches_oracle_single_state():
+    """Also anchor against the pure-Python per-state oracle key."""
+    from raft_tla_tpu.models import interp
+
+    bounds = BOUNDS[1]
+    lay = st.Layout.of(bounds)
+    py = interp.init_state(bounds)
+    vec = np.asarray(interp.to_vec(py, bounds), np.int32)
+    hi, lo = sym.py_orbit_fingerprint(py, bounds, ("Server",))
+    fn = pallas_orbit.build_orbit_fp(bounds, ("Server",), False,
+                                     interpret=True)
+    got_hi, got_lo = fn(jnp.asarray(vec[None, :]))
+    assert int(got_hi[0]) == int(np.uint32(hi))
+    assert int(got_lo[0]) == int(np.uint32(lo))
