@@ -64,8 +64,8 @@ import numpy as np
 
 from raft_tla_tpu.config import CheckConfig
 from raft_tla_tpu.device_engine import (
-    _EMPTY, BUCKET, FAIL_INDEX, FAIL_LEVEL, FAIL_WIDTH, aggregate_coverage,
-    decode_fail)
+    _EMPTY, BUCKET, FAIL_INDEX, FAIL_LEVEL, FAIL_ROUTE, FAIL_WIDTH,
+    aggregate_coverage, decode_fail)
 from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import bitpack
@@ -94,13 +94,20 @@ class DDDCapacities:
     next chunk might not fit — dispatch round-trips over the deployment
     tunnel cost ~100-300 ms, so per-chunk dispatch is ~10x slower);
     ``flush``: pending candidates per host dedup pass; ``levels``:
-    host-side BFS-depth bound."""
+    host-side BFS-depth bound; ``route_rows``: >0 switches the chunk
+    program to the EP-routed step (kernels.build_step_routed) with that
+    many compacted candidate slots per chunk — discovery order is
+    engine-identical (the parity suite asserts it), so like ``table``/
+    ``seg_rows``/``flush`` it is checkpoint-compatible tuning, not
+    digest identity; a chunk with more enabled lanes than slots aborts
+    loudly (FAIL_ROUTE)."""
 
     block: int = 1 << 20
     table: int = 1 << 26
     seg_rows: int = 1 << 19
     flush: int = 1 << 23
     levels: int = 1 << 12
+    route_rows: int = 0
 
     def __post_init__(self):
         for nm in ("block", "table"):
@@ -110,6 +117,8 @@ class DDDCapacities:
         if self.table < BUCKET:
             raise ValueError(
                 f"table={self.table} must be >= one bucket ({BUCKET})")
+        if self.route_rows < 0:
+            raise ValueError(f"route_rows={self.route_rows} must be >= 0")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -156,12 +165,14 @@ class SegBufs(NamedTuple):
 class SegStats(NamedTuple):
     cursor: jax.Array     # streamed rows this segment (output fill)
     n_valid: jax.Array    # transitions counted (truncated at violation)
-    fail: jax.Array       # FAIL_WIDTH bit
+    fail: jax.Array       # FAIL_WIDTH / FAIL_ROUTE bits
     viol_kind: jax.Array  # 0 none / 1 invariant / 2 deadlock
     viol_inv: jax.Array   # invariant index (kind 1)
     dead_g: jax.Array     # kind 2: dead state's discovery index
     steps: jax.Array      # chunks executed (pacer signal)
     done: jax.Array       # block exhausted
+    peak: jax.Array       # max live enabled lanes in any chunk — the
+                          # route_rows sizing signal (both step shapes)
 
 
 class _SegCarry(NamedTuple):
@@ -183,6 +194,7 @@ class _SegCarry(NamedTuple):
     viol_inv: jax.Array
     dead_g: jax.Array
     c: jax.Array
+    peak: jax.Array
 
 
 def _filter_insert(tbl_hi, tbl_lo, key_hi, key_lo, active):
@@ -233,36 +245,75 @@ def _build_segment(config: CheckConfig, caps: DDDCapacities, A: int,
     failure is flagged, or the budget is spent."""
     B = config.chunk
     N = B * A
+    routed = caps.route_rows > 0
+    NK = caps.route_rows if routed else N   # max streamed rows per chunk
     OCAP = caps.seg_rows
-    if OCAP < N:
+    if OCAP < NK:
         raise ValueError(
-            f"seg_rows={OCAP} must be >= chunk * actions = {N}")
+            f"seg_rows={OCAP} must be >= per-chunk candidate rows = {NK}")
     n_inv = len(config.invariants)
-    step = kernels.build_step(config.bounds, config.spec,
-                              tuple(config.invariants), config.symmetry)
+    if routed:
+        step = kernels.build_step_routed(
+            config.bounds, config.spec, tuple(config.invariants),
+            config.symmetry, k_rows=caps.route_rows)
+    else:
+        step = kernels.build_step(config.bounds, config.spec,
+                                  tuple(config.invariants), config.symmetry)
     BIG = jnp.int32(np.iinfo(np.int32).max)
 
     def chunk_body(carry: _SegCarry) -> _SegCarry:
         (tbl_hi, tbl_lo, okey_hi, okey_lo, orows, opar, olane, ocon,
-         cursor, n_valid_a, fail, viol_kind, viol_inv, dead_g, c) = carry
+         cursor, n_valid_a, fail, viol_kind, viol_inv, dead_g, c,
+         peak) = carry
         r0 = c * B
         rows_b = r0 + jnp.arange(B, dtype=I32)
         row_act = rows_b < block_rows
         bidx = jnp.minimum(rows_b, caps.block - 1)
         vecs = schema.unpack(fbuf[bidx], jnp)
-        out = step(vecs)
-        valid = out["valid"] & row_act[:, None] & fcon[bidx][:, None]
+        row_ok = row_act & fcon[bidx]
+        out = step(vecs, row_ok) if routed else step(vecs)
+        valid = out["valid"] & row_ok[:, None]
         fvalid = valid.reshape(-1)
         iota = jnp.arange(N, dtype=I32)
+
+        # Normalize both step shapes to one candidate stream of NK rows
+        # in flat (b*A + a) order: ``src`` = flat source lane, ``order``
+        # = flat position for refbfs-exact truncation, ``cand_act`` =
+        # live candidate.  Dense: the full N-lane grid.  Routed: the
+        # step's compacted slots (already row_ok-masked — only live
+        # rows' lanes consume routing budget).
+        peak = jnp.maximum(peak, out["n_en"] if routed
+                           else jnp.sum(fvalid.astype(I32)))
+        if routed:
+            cidx = out["cidx"]
+            src = jnp.minimum(cidx, N - 1)
+            cand_act = out["cvalid"]
+            order = cidx
+            kh, kl = out["cfp_hi"], out["cfp_lo"]
+            inv_ok_rows = out["cinv_ok"]
+            ovf_rows = out["overflow"].reshape(-1)[src]
+            con_rows = out["ccon_ok"]
+            word_rows = out["csvecs"]
+            route_ovf = out["route_ovf"]
+        else:
+            src = iota
+            cand_act = fvalid
+            order = iota
+            kh = out["fp_hi"].reshape(-1)
+            kl = out["fp_lo"].reshape(-1)
+            inv_ok_rows = out["inv_ok"].reshape(N, n_inv)
+            ovf_rows = out["overflow"].reshape(-1)
+            con_rows = out["con_ok"].reshape(-1)
+            word_rows = out["svecs"].reshape(N, W)
+            route_ovf = jnp.bool_(False)
 
         # refbfs-exact truncation: first invariant violation (violator
         # kept) vs first dead row (its and later rows' candidates cut),
         # ordered the way streamed_engine orders them (flat candidate
         # position vs drow * A)
-        inv_bad = fvalid & jnp.any(
-            ~out["inv_ok"].reshape(N, n_inv), axis=-1) if n_inv \
-            else jnp.zeros((N,), bool)
-        first_inv = jnp.min(jnp.where(inv_bad, iota, BIG))
+        inv_bad = cand_act & jnp.any(~inv_ok_rows, axis=-1) if n_inv \
+            else jnp.zeros((NK,), bool)
+        first_inv = jnp.min(jnp.where(inv_bad, order, BIG))
         if config.check_deadlock:
             dead = row_act & fcon[bidx] & ~jnp.any(out["valid"], axis=1)
             drow = jnp.min(jnp.where(dead, jnp.arange(B, dtype=I32), BIG))
@@ -274,43 +325,49 @@ def _build_segment(config: CheckConfig, caps: DDDCapacities, A: int,
         has_inv = (first_inv < BIG) & ~use_dead
         cut_incl = jnp.where(use_dead, dpos - 1,
                              jnp.where(first_inv < BIG, first_inv, BIG))
-        keep = iota <= cut_incl
-        kvalid = fvalid & keep
+        keep = order <= cut_incl
+        kvalid = cand_act & keep
         n_valid_a = n_valid_a + jnp.sum(kvalid.astype(I32))
-        fail = fail | jnp.any(
-            kvalid & out["overflow"].reshape(-1)).astype(I32) * FAIL_WIDTH
+        fail = fail | jnp.any(kvalid & ovf_rows).astype(I32) * FAIL_WIDTH
 
-        fhi = out["fp_hi"].reshape(-1)
-        flo = out["fp_lo"].reshape(-1)
-        tbl_hi, tbl_lo, stream = _filter_insert(tbl_hi, tbl_lo, fhi, flo,
+        tbl_hi, tbl_lo, stream = _filter_insert(tbl_hi, tbl_lo, kh, kl,
                                                 kvalid)
         pos = cursor + jnp.cumsum(stream.astype(I32)) - 1
         sl = jnp.where(stream, pos, OCAP)
-        svecs = schema.pack(out["svecs"].reshape(N, W), jnp)
-        okey_hi = okey_hi.at[sl].set(fhi, mode="drop")
-        okey_lo = okey_lo.at[sl].set(flo, mode="drop")
+        svecs = schema.pack(word_rows, jnp)
+        okey_hi = okey_hi.at[sl].set(kh, mode="drop")
+        okey_lo = okey_lo.at[sl].set(kl, mode="drop")
         orows = orows.at[sl].set(svecs, mode="drop")
-        opar = opar.at[sl].set(block_start + r0 + iota // A, mode="drop")
-        olane = olane.at[sl].set(iota % A, mode="drop")
-        ocon = ocon.at[sl].set(out["con_ok"].reshape(-1), mode="drop")
+        opar = opar.at[sl].set(block_start + r0 + src // A, mode="drop")
+        olane = olane.at[sl].set(src % A, mode="drop")
+        ocon = ocon.at[sl].set(con_rows, mode="drop")
         cursor = cursor + jnp.sum(stream.astype(I32))
 
         viol_kind = jnp.where(use_dead, 2, jnp.where(has_inv, 1, 0)) \
             .astype(I32)
-        viol_inv_c = jnp.argmax(~out["inv_ok"].reshape(N, n_inv)[
-            jnp.minimum(first_inv, N - 1)]) if n_inv else jnp.int32(0)
+        # A detected invariant violation outranks a routing overflow:
+        # compaction keeps the FIRST K enabled lanes in flat order, so
+        # every dropped lane lies past the detected violator — beyond
+        # the truncation cut the dense engine applies anyway — and the
+        # emitted stream is already dense-exact.  A deadlock cut (or no
+        # detection at all) may have lost pre-cut candidates: abort.
+        fail = fail | (route_ovf & (viol_kind != 1)).astype(I32) \
+            * FAIL_ROUTE
+        viol_inv_c = jnp.argmax(~inv_ok_rows[
+            jnp.argmin(jnp.where(inv_bad, order, BIG))]) \
+            if n_inv else jnp.int32(0)
         dead_g = jnp.where(
             use_dead, block_start + r0 + jnp.minimum(drow, B - 1), dead_g)
         return _SegCarry(tbl_hi, tbl_lo, okey_hi, okey_lo, orows, opar,
                          olane, ocon, cursor, n_valid_a, fail, viol_kind,
-                         viol_inv_c.astype(I32), dead_g, c + 1)
+                         viol_inv_c.astype(I32), dead_g, c + 1, peak)
 
     def cond(sc):
         s, carry = sc
         n_chunks = (block_rows + B - 1) // B
         return ((carry.c < n_chunks) & (carry.viol_kind == 0)
                 & (carry.fail == 0) & (s < budget)
-                & (carry.cursor + N <= OCAP))
+                & (carry.cursor + NK <= OCAP))
 
     def body(sc):
         s, carry = sc
@@ -326,7 +383,7 @@ def _build_segment(config: CheckConfig, caps: DDDCapacities, A: int,
             fc.tbl_hi, fc.tbl_lo, *bufs,
             cursor=jnp.int32(0), n_valid=jnp.int32(0), fail=jnp.int32(0),
             viol_kind=jnp.int32(0), viol_inv=jnp.int32(0),
-            dead_g=jnp.int32(-1), c=fc.c)
+            dead_g=jnp.int32(-1), c=fc.c, peak=jnp.int32(0))
         steps, carry = jax.lax.while_loop(cond, body,
                                           (jnp.int32(0), carry))
         n_chunks = (block_rows + B - 1) // B
@@ -335,7 +392,7 @@ def _build_segment(config: CheckConfig, caps: DDDCapacities, A: int,
                         carry.opar, carry.olane, carry.ocon),
                 SegStats(carry.cursor, carry.n_valid, carry.fail,
                          carry.viol_kind, carry.viol_inv, carry.dead_g,
-                         steps, carry.c >= n_chunks))
+                         steps, carry.c >= n_chunks, carry.peak))
 
     fbuf = fcon = budget = block_start = block_rows = None
     return segment
@@ -550,6 +607,7 @@ class DDDEngine:
         viol = None          # (kind, inv_idx, dead_g) once detected
         viol_key = None
         fail = 0
+        route_peak = 0       # max live enabled lanes seen in any chunk
         complete = True
         stopped = False
         t_warm = None
@@ -572,6 +630,7 @@ class DDDEngine:
                 "dedup_hit_rate": round(
                     max(0.0, 1.0 - n_states / max(n_trans, 1)), 4),
                 "states_per_sec": round(n_states / max(wall, 1e-9), 1),
+                "route_peak": route_peak,
                 "coverage": dict(aggregate_coverage(self.table, cov)),
             })
 
@@ -640,6 +699,7 @@ class DDDEngine:
                     st_h = jax.device_get(stats)
                     ns, nv = int(st_h.cursor), int(st_h.n_valid)
                     vk = int(st_h.viol_kind)
+                    route_peak = max(route_peak, int(st_h.peak))
                     bufs_h = jax.device_get(bufsets[idx]) \
                         if ns and not stopped else None
                     free.append(idx)

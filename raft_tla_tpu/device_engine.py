@@ -199,7 +199,9 @@ FAIL_PROBE = 2      # linear probe exceeded _MAX_PROBE (table too full)
 FAIL_STORE = 4      # more distinct states than Capacities.n_states
 FAIL_LEVEL = 8      # BFS deeper than Capacities.levels
 FAIL_RING = 16      # paged engine: live BFS window outgrew the HBM ring
-# 32 is FAIL_ROUTE (shard engine, parallel/shard_engine.py)
+FAIL_ROUTE = 32     # a routing budget overflowed: shard engine's
+                    # all_to_all exchange halo, or the EP-routed step's
+                    # route_rows compaction slots (ddd_engine)
 FAIL_INDEX = 64     # paged engine: discovery index near the int32 ceiling
 
 _FAIL_TEXT = {
@@ -208,6 +210,8 @@ _FAIL_TEXT = {
     FAIL_STORE: "state-store capacity exceeded",
     FAIL_LEVEL: "BFS level capacity exceeded",
     FAIL_RING: "live BFS window exceeded the HBM ring",
+    FAIL_ROUTE: "routing budget exceeded (all_to_all halo or EP "
+                "route_rows too small)",
     FAIL_INDEX: "global state index reached the int32 ceiling "
                 "(2^31-1 rows/device is the per-run limit)",
 }

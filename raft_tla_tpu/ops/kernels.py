@@ -454,6 +454,48 @@ _FAMILY_KERNELS = {
 }
 
 
+def group_instances(table):
+    """Group contiguous instances of the same family for vectorized
+    dispatch (shared by the dense and CP-sharded expansions)."""
+    groups: list[tuple[str, list[SP.ActionInstance]]] = []
+    for a in table:
+        if groups and groups[-1][0] == a.family:
+            groups[-1][1].append(a)
+        else:
+            groups.append((a.family, [a]))
+    return groups
+
+
+def grouped_dispatch(bounds, s, groups):
+    """Evaluate the family kernels over grouped static instances:
+    ``-> (succs list, valids list, ovfs list)`` of per-group arrays."""
+    succs, valids, ovfs = [], [], []
+    for fam, instances in groups:
+        kern, params = _FAMILY_KERNELS[fam]
+        args = [jnp.asarray([getattr(a, p) for a in instances], dtype=I32)
+                for p in params]
+        fn = functools.partial(kern, bounds)
+        batched = jax.vmap(fn, in_axes=(None,) + (0,) * len(args))
+        out, valid, ovf = batched(s, *args)
+        succs.append(out)
+        valids.append(jnp.broadcast_to(valid, (len(instances),)))
+        ovfs.append(jnp.broadcast_to(ovf, (len(instances),)))
+    return succs, valids, ovfs
+
+
+def finish_expand(bounds, s, succs, valids, ovfs):
+    """Concatenate per-group lanes, apply the shared allLogs union
+    (faithful mode), canonicalize every successor — the one definition
+    of an expansion's postlude (dense and CP twins both end here)."""
+    all_succs = jax.tree.map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *succs)
+    if "allLogs" in s:
+        all_succs["allLogs"] = _alllogs_update(
+            bounds, s, all_succs["allLogs"].shape[0])
+    all_succs = jax.vmap(lambda t: st.canonicalize(t, jnp))(all_succs)
+    return all_succs, jnp.concatenate(valids), jnp.concatenate(ovfs)
+
+
 def build_expand(bounds: Bounds, spec: str = "full"):
     """Build ``expand(struct) -> (succs[A,...], valid[A], overflow[A])``.
 
@@ -461,68 +503,41 @@ def build_expand(bounds: Bounds, spec: str = "full"):
     every successor is canonicalized (message slots sorted).  Pure function
     of a single state struct — vmap/jit at the call site.
     """
-    table = SP.action_table(bounds, spec)
-    # Group contiguous instances of the same family for vectorized dispatch.
-    groups: list[tuple[str, list[SP.ActionInstance]]] = []
-    for a in table:
-        if groups and groups[-1][0] == a.family:
-            groups[-1][1].append(a)
-        else:
-            groups.append((a.family, [a]))
+    groups = group_instances(SP.action_table(bounds, spec))
 
     def expand(s):
-        succs, valids, ovfs = [], [], []
-        for fam, instances in groups:
-            kern, params = _FAMILY_KERNELS[fam]
-            args = [jnp.asarray([getattr(a, p) for a in instances], dtype=I32)
-                    for p in params]
-            fn = functools.partial(kern, bounds)
-            batched = jax.vmap(fn, in_axes=(None,) + (0,) * len(args))
-            out, valid, ovf = batched(s, *args)
-            succs.append(out)
-            valids.append(jnp.broadcast_to(valid, (len(instances),)))
-            ovfs.append(jnp.broadcast_to(ovf, (len(instances),)))
-        all_succs = jax.tree.map(
-            lambda *xs: jnp.concatenate(xs, axis=0), *succs)
-        if "allLogs" in s:
-            # allLogs' = allLogs \cup {log[i] : i \in Server}, conjoined
-            # with the UNPRIMED logs onto every disjunct (raft.tla:464-465)
-            # — one shared update broadcast across all successor lanes.
-            uni = loguniv.LogUniverse.of(bounds)
-            Wa = s["allLogs"].shape[0]
-            ids = uni.log_id(s["logTerm"], s["logVal"], s["logLen"], jnp)
-            word, bit = ids // 32, ids % 32
-            shift = jnp.left_shift(jnp.int32(1), bit)           # [n]
-            masks = jnp.where(jnp.arange(Wa)[None, :] == word[:, None],
-                              shift[:, None], 0)                # [n, Wa]
-            delta = masks[0]
-            for t in range(1, masks.shape[0]):
-                delta = delta | masks[t]
-            new_all = (s["allLogs"] | delta).astype(I32)
-            A = all_succs["allLogs"].shape[0]
-            all_succs["allLogs"] = jnp.broadcast_to(new_all, (A, Wa))
-        all_succs = jax.vmap(lambda t: st.canonicalize(t, jnp))(all_succs)
-        return all_succs, jnp.concatenate(valids), jnp.concatenate(ovfs)
+        succs, valids, ovfs = grouped_dispatch(bounds, s, groups)
+        return finish_expand(bounds, s, succs, valids, ovfs)
 
     return expand
 
 
-def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
-               symmetry: tuple = ()):
-    """One fused frontier step: packed vecs -> everything the engine needs.
+def _alllogs_update(bounds, s, n_lanes):
+    """``allLogs' = allLogs \\cup {log[i] : i \\in Server}``, conjoined
+    with the UNPRIMED logs onto every disjunct (raft.tla:464-465) — one
+    shared update broadcast across all ``n_lanes`` successor lanes."""
+    uni = loguniv.LogUniverse.of(bounds)
+    Wa = s["allLogs"].shape[0]
+    ids = uni.log_id(s["logTerm"], s["logVal"], s["logLen"], jnp)
+    word, bit = ids // 32, ids % 32
+    shift = jnp.left_shift(jnp.int32(1), bit)           # [n]
+    masks = jnp.where(jnp.arange(Wa)[None, :] == word[:, None],
+                      shift[:, None], 0)                # [n, Wa]
+    delta = masks[0]
+    for t in range(1, masks.shape[0]):
+        delta = delta | masks[t]
+    new_all = (s["allLogs"] | delta).astype(I32)
+    return jnp.broadcast_to(new_all, (n_lanes, Wa))
 
-    ``step(vecs[B, W]) -> dict`` with packed successors ``svecs [B, A, W]``,
-    ``valid``/``overflow`` ``[B, A]``, fingerprint lanes ``fp_hi/fp_lo``
-    ``[B, A]`` (uint32), per-invariant truth ``inv_ok [B, A, n_inv]``, and
-    StateConstraint satisfaction ``con_ok [B, A]``.  Everything downstream of
-    the expansion fuses into one XLA computation — one device round-trip per
-    frontier chunk.
 
-    With ``symmetry=("Server",)`` the fingerprint lanes become the
-    orbit-minimal fingerprint over all server permutations
-    (ops/symmetry.py) — the dedup key that quotients the state space the
-    way TLC's SYMMETRY stanza does.
-    """
+def _step_stages(bounds: Bounds, spec: str, invariants: tuple,
+                 symmetry: tuple):
+    """The shared builder prologue of the dense and EP-routed steps:
+    layout, fingerprint constants, the expansion, the invariant
+    predicates, and the orbit-fingerprint pipeline.  One definition site
+    so the two steps can never disagree on key arithmetic (the parity
+    and checkpoint-compatibility guarantees rest on bit-identical
+    fingerprints)."""
     from raft_tla_tpu.models import invariants as inv_mod
     from raft_tla_tpu.ops import symmetry as sym
 
@@ -546,31 +561,159 @@ def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
         from raft_tla_tpu.ops import pallas_orbit
         pallas_orbit_fp = pallas_orbit.build_orbit_fp(
             bounds, symmetry, "allLogs" in lay.shapes)
+    return lay, consts, expand, inv_fns, orbit_fp, pallas_orbit_fp
+
+
+def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
+               symmetry: tuple = ()):
+    """One fused frontier step: packed vecs -> everything the engine needs.
+
+    ``step(vecs[B, W]) -> dict`` with packed successors ``svecs [B, A, W]``,
+    ``valid``/``overflow`` ``[B, A]``, fingerprint lanes ``fp_hi/fp_lo``
+    ``[B, A]`` (uint32), per-invariant truth ``inv_ok [B, A, n_inv]``, and
+    StateConstraint satisfaction ``con_ok [B, A]``.  Everything downstream of
+    the expansion fuses into one XLA computation — one device round-trip per
+    frontier chunk.
+
+    With ``symmetry=("Server",)`` the fingerprint lanes become the
+    orbit-minimal fingerprint over all server permutations
+    (ops/symmetry.py) — the dedup key that quotients the state space the
+    way TLC's SYMMETRY stanza does.
+    """
+    stages = _step_stages(bounds, spec, invariants, symmetry)
+    lay = stages[0]
+    expand = stages[2]
 
     def step(vecs):
         structs = jax.vmap(lambda v: st.unpack(v, lay, jnp))(vecs)
         succs, valid, ovf = jax.vmap(expand)(structs)
         svecs = jax.vmap(jax.vmap(lambda t: st.pack(t, jnp)))(succs)
-        if symmetry:
-            if pallas_orbit_fp is not None:
-                fh, fl = pallas_orbit_fp(svecs.reshape(-1, lay.width))
-            else:
-                flat = jax.tree.map(
-                    lambda a: a.reshape((-1,) + a.shape[2:]), succs)
-                fh, fl = orbit_fp(flat)
-            fp_hi = fh.reshape(svecs.shape[:2])
-            fp_lo = fl.reshape(svecs.shape[:2])
-        else:
-            fp_hi, fp_lo = fpr.fingerprint(svecs, consts, jnp)
-        if inv_fns:
-            inv_ok = jnp.stack(
-                [jax.vmap(jax.vmap(f))(succs) for f in inv_fns], axis=-1)
-        else:
-            inv_ok = jnp.ones(valid.shape + (0,), dtype=bool)
-        con_ok = jax.vmap(jax.vmap(
-            lambda t: st.constraint_ok(t, bounds, jnp)))(succs)
+        # (EP-routed twin: build_step_routed compacts the valid lanes
+        # before these per-candidate stages — same values, K-shaped.)
+        fp_hi, fp_lo, inv_ok, con_ok = apply_stages(
+            bounds, stages, symmetry, succs, svecs, valid)
         return {"svecs": svecs, "valid": valid, "overflow": ovf,
                 "fp_hi": fp_hi, "fp_lo": fp_lo, "inv_ok": inv_ok,
                 "con_ok": con_ok}
+
+    return step
+
+
+def apply_stages(bounds, stages, symmetry, succs, svecs, valid):
+    """The per-candidate stage block on ``[B, A]``-shaped successors —
+    orbit/plain fingerprints, invariants, StateConstraint.  One
+    definition shared by the dense step and the CP-sharded step (the
+    EP-routed step runs the same stages on its compacted ``[K]`` axis)."""
+    lay, consts, _expand, inv_fns, orbit_fp, pallas_orbit_fp = stages
+    if symmetry:
+        if pallas_orbit_fp is not None:
+            fh, fl = pallas_orbit_fp(svecs.reshape(-1, lay.width))
+        else:
+            flat = jax.tree.map(
+                lambda a: a.reshape((-1,) + a.shape[2:]), succs)
+            fh, fl = orbit_fp(flat)
+        fp_hi = fh.reshape(svecs.shape[:2])
+        fp_lo = fl.reshape(svecs.shape[:2])
+    else:
+        fp_hi, fp_lo = fpr.fingerprint(svecs, consts, jnp)
+    if inv_fns:
+        inv_ok = jnp.stack(
+            [jax.vmap(jax.vmap(f))(succs) for f in inv_fns], axis=-1)
+    else:
+        inv_ok = jnp.ones(valid.shape + (0,), dtype=bool)
+    con_ok = jax.vmap(jax.vmap(
+        lambda t: st.constraint_ok(t, bounds, jnp)))(succs)
+    return fp_hi, fp_lo, inv_ok, con_ok
+
+
+def build_step_routed(bounds: Bounds, spec: str = "full",
+                      invariants: tuple = (), symmetry: tuple = (),
+                      k_rows: int = 0):
+    """EP-style routed frontier step (SURVEY §2.9, EP row): compact the
+    enabled lanes, then run the expensive per-candidate stages densely.
+
+    The dense :func:`build_step` evaluates pack/fingerprint/orbit/
+    invariant/constraint on ALL ``B*A`` successor lanes, but measured
+    transition density is ~6-10% of the fan-out (258.1M transitions over
+    94.4M x 42 lanes on the flagship; RESULTS.md) — ~90% of the dominant
+    orbit pass (|G| = n!*V! permutations, runs/xla_profile/SUMMARY.md) is
+    spent on guard-disabled lanes.  This is the MoE-routing analog: the
+    cheap elementwise expansion plays the router, a stable-order
+    compaction (cumsum positions + scatter/gather, no sort) routes the
+    enabled (state, action) pairs into ``k_rows`` dense slots, and the
+    orbit/fingerprint/invariant "experts" see only live work.
+
+    ``step(vecs[B, W], row_ok[B]) -> dict`` with the dense ``valid``/
+    ``overflow`` ``[B, A]`` (the engine's deadlock/truncation logic reads
+    these; NOT masked by ``row_ok``) plus the compacted candidate stream,
+    ordered by flat lane index ``b*A + a`` — byte-identical discovery
+    order to the dense step.  ``row_ok`` marks the chunk rows that are
+    live (inside the block, constraint-satisfying): only their lanes
+    consume routing slots — without it, the stale padded rows of a
+    partial chunk would eat the budget and could trigger spurious
+    ``route_ovf`` aborts.  Pass ``None`` when every row is live.
+
+    - ``cidx [K] int32``: flat source index of each routed lane
+      (``N = B*A`` for padding slots), strictly increasing on the live
+      prefix;
+    - ``cvalid [K]``: slot holds a routed lane;
+    - ``csvecs [K, W]``, ``cfp_hi/cfp_lo [K]``, ``cinv_ok [K, n_inv]``,
+      ``ccon_ok [K]``: exactly the dense step's values at ``cidx``;
+    - ``route_ovf``: scalar bool — more than ``k_rows`` enabled lanes
+      (the caller must abort loudly: candidates would be LOST, and
+      "exhaustive" may not silently mean "sampled", SURVEY §4.5).
+
+    Sizing: worst case is ``k_rows = B*A`` (full density — no saving, no
+    loss); the measured regime makes ``B*A // 4`` a >=2.5x-headroom
+    default.  Correct for parity AND faithful mode (the expansion twin
+    carries the allLogs update; history fields ride the same gather).
+    """
+    (lay, consts, expand, inv_fns, orbit_fp,
+     pallas_orbit_fp) = _step_stages(bounds, spec, invariants, symmetry)
+    if k_rows <= 0:
+        raise ValueError(f"k_rows={k_rows} must be positive")
+    K = int(k_rows)
+
+    def step(vecs, row_ok=None):
+        B = vecs.shape[0]
+        structs = jax.vmap(lambda v: st.unpack(v, lay, jnp))(vecs)
+        succs, valid, ovf = jax.vmap(expand)(structs)
+        A = valid.shape[1]
+        N = B * A
+        live = valid if row_ok is None else valid & row_ok[:, None]
+        fvalid = live.reshape(-1)
+        # Stable compaction: slot k <- k-th enabled flat lane.  cumsum
+        # preserves flat order, so the compacted stream IS the dense
+        # stream with the dead lanes deleted — discovery order (hence
+        # counts, coverage, traces, checkpoints) is engine-identical.
+        pos = jnp.cumsum(fvalid.astype(I32)) - 1
+        n_en = jnp.where(N > 0, pos[-1] + 1, 0)
+        route_ovf = n_en > K
+        slot = jnp.where(fvalid & (pos < K), pos, K)
+        cidx = jnp.full((K,), N, dtype=I32).at[slot].set(
+            jnp.arange(N, dtype=I32), mode="drop")
+        cvalid = cidx < N
+        gidx = jnp.minimum(cidx, N - 1)
+        flat = jax.tree.map(lambda a: a.reshape((N,) + a.shape[2:]), succs)
+        csucc = jax.tree.map(lambda a: a[gidx], flat)
+        csvecs = jax.vmap(lambda t: st.pack(t, jnp))(csucc)
+        if symmetry:
+            if pallas_orbit_fp is not None:
+                cfp_hi, cfp_lo = pallas_orbit_fp(csvecs)
+            else:
+                cfp_hi, cfp_lo = orbit_fp(csucc)
+        else:
+            cfp_hi, cfp_lo = fpr.fingerprint(csvecs, consts, jnp)
+        if inv_fns:
+            cinv_ok = jnp.stack([jax.vmap(f)(csucc) for f in inv_fns],
+                                axis=-1)
+        else:
+            cinv_ok = jnp.ones((K, 0), dtype=bool)
+        ccon_ok = jax.vmap(
+            lambda t: st.constraint_ok(t, bounds, jnp))(csucc)
+        return {"valid": valid, "overflow": ovf, "cidx": cidx,
+                "cvalid": cvalid, "csvecs": csvecs, "cfp_hi": cfp_hi,
+                "cfp_lo": cfp_lo, "cinv_ok": cinv_ok, "ccon_ok": ccon_ok,
+                "route_ovf": route_ovf, "n_en": n_en}
 
     return step

@@ -390,8 +390,12 @@ def build_orbit_fp(bounds: Bounds, axes: tuple, consts, faithful: bool):
             take = (hi < bh) | ((hi == bh) & (lo < bl))
             return (jnp.where(take, hi, bh), jnp.where(take, lo, bl)), None
 
-        init = (jnp.full((N,), 0xFFFFFFFF, jnp.uint32),
-                jnp.full((N,), 0xFFFFFFFF, jnp.uint32))
+        # derive the +inf init from the input so it inherits the input's
+        # varying manual axes — a constant-built carry breaks the scan
+        # type match when this runs inside shard_map (CP lane sharding)
+        top = jnp.zeros_like(struct["role"][:, 0]).astype(jnp.uint32) \
+            | jnp.uint32(0xFFFFFFFF)
+        init = (top, top)
         (bh, bl), _ = jax.lax.scan(body, init,
                                    jnp.arange(P * Q, dtype=jnp.int32))
         return bh, bl

@@ -1,0 +1,139 @@
+"""CP analog — the per-state message-bag scan partitioned across devices.
+
+SURVEY §2.9's CP row: "partition the per-state message bag scan across
+lanes when M is large."  Within one device the scan is already
+lane-parallel (each bag slot is an action lane of the dense fan-out,
+`models/spec.action_table`).  This module partitions it across MESH
+devices: the bag-driven families — ``Receive(m)``, ``DuplicateMessage(m)``,
+``DropMessage(m)`` (``raft.tla:461-463``), the only lanes that grow with
+the ``MaxMsgSlots`` bound — are sharded by SLOT, so each device expands
+the same frontier chunk over ``ceil(S / ndev)`` slots per bag family
+while the fixed-size non-bag lanes ride on device 0 (dense compute,
+device-masked validity — they are the cheap minority precisely when CP
+pays, at large M).
+
+Because exhaustive dedup is keyed on state fingerprints — not on which
+device produced a candidate — the per-device partial fan-outs compose
+with the FP-prefix ``all_to_all`` dedup exchange exactly like
+frontier-sharded (DP) candidates; a CP engine's deterministic stream
+order is (device-major, local-lane), exposed by :func:`cp_lane_map`.
+
+Built on the same family kernels and stage pipeline as the dense step
+(``ops/kernels``): per-lane values are bit-identical to
+``kernels.build_step`` at the mapped dense lane (asserted by
+tests/test_cp_expand.py on the virtual 8-device mesh).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.models import spec as SP
+from raft_tla_tpu.ops import kernels
+from raft_tla_tpu.ops import state as st
+
+I32 = jnp.int32
+
+_BAG_FAMS = (SP.RECEIVE, SP.DUPLICATE, SP.DROP)
+
+
+def _split(bounds: Bounds, spec: str):
+    """(non-bag instances, bag families present, slots per bag family)."""
+    table = SP.action_table(bounds, spec)
+    nonbag = [a for a in table if a.family not in _BAG_FAMS]
+    bagfams = [f for f in _BAG_FAMS if f in SP.SPECS[spec]]
+    return nonbag, bagfams, bounds.msg_cap
+
+
+def cp_lane_count(bounds: Bounds, spec: str, ndev: int) -> int:
+    """Per-device lane count A_local = n_nonbag + n_bagfams * ceil(S/ndev)."""
+    nonbag, bagfams, S = _split(bounds, spec)
+    return len(nonbag) + len(bagfams) * (-(-S // ndev))
+
+
+def cp_lane_map(bounds: Bounds, spec: str, ndev: int) -> np.ndarray:
+    """``[ndev, A_local]`` dense-table lane index of each local lane, or
+    -1 for lanes that are dead on that device (non-bag lanes off device
+    0; slot padding past S).  The union of the >=0 entries is exactly
+    ``range(len(action_table))``, each exactly once."""
+    nonbag, bagfams, S = _split(bounds, spec)
+    Sp = -(-S // ndev)
+    table = SP.action_table(bounds, spec)
+    base = {}
+    for g, a in enumerate(table):
+        if a.family in _BAG_FAMS and a.slot == 0:
+            base[a.family] = g
+    out = np.full((ndev, cp_lane_count(bounds, spec, ndev)), -1, np.int32)
+    for d in range(ndev):
+        for l in range(len(nonbag)):
+            if d == 0:
+                out[d, l] = l
+        for fi, fam in enumerate(bagfams):
+            for k in range(Sp):
+                slot = d * Sp + k
+                if slot < S:
+                    out[d, len(nonbag) + fi * Sp + k] = base[fam] + slot
+    return out
+
+
+def build_cp_expand(bounds: Bounds, spec: str = "full", ndev: int = 1):
+    """Per-device slice of the action fan-out: ``expand(s, dev) ->
+    (succs[A_local, ...], valid[A_local], overflow[A_local])``.
+
+    ``dev`` is the traced device index (``jax.lax.axis_index`` under
+    ``shard_map``); bag-family slot arguments are computed from it, so
+    one program serves every mesh position.  Canonicalization and the
+    faithful-mode allLogs union match ``kernels.build_expand`` exactly.
+    """
+    nonbag, bagfams, S = _split(bounds, spec)
+    Sp = -(-S // ndev)
+    groups = kernels.group_instances(nonbag)
+
+    def expand(s, dev):
+        succs, valids, ovfs = kernels.grouped_dispatch(bounds, s, groups)
+        on_dev0 = dev == 0
+        valids = [v & on_dev0 for v in valids]
+        ovfs = [o & on_dev0 for o in ovfs]
+        slots = dev * Sp + jnp.arange(Sp, dtype=I32)
+        in_range = slots < S
+        slot_arg = jnp.minimum(slots, S - 1)
+        for fam in bagfams:
+            kern, _ = kernels._FAMILY_KERNELS[fam]
+            out, valid, ovf = jax.vmap(
+                lambda sl: kern(bounds, s, sl))(slot_arg)
+            succs.append(out)
+            valids.append(jnp.broadcast_to(valid, (Sp,)) & in_range)
+            ovfs.append(jnp.broadcast_to(ovf, (Sp,)) & in_range)
+        return kernels.finish_expand(bounds, s, succs, valids, ovfs)
+
+    return expand
+
+
+def build_cp_step(bounds: Bounds, spec: str = "full",
+                  invariants: tuple = (), symmetry: tuple = (),
+                  ndev: int = 1):
+    """The dense step's CP twin: ``step(vecs[B, W], dev) -> dict`` with
+    ``svecs [B, A_local, W]``, ``valid``/``overflow`` ``[B, A_local]``,
+    ``fp_hi/fp_lo``, ``inv_ok``, ``con_ok`` — per-lane values
+    bit-identical to ``kernels.build_step`` at ``cp_lane_map``'s dense
+    index.  Call inside ``shard_map`` with ``dev = lax.axis_index(axis)``.
+    """
+    stages = kernels._step_stages(bounds, spec, invariants, symmetry)
+    lay = stages[0]
+    expand = build_cp_expand(bounds, spec, ndev)
+
+    def step(vecs, dev):
+        structs = jax.vmap(lambda v: st.unpack(v, lay, jnp))(vecs)
+        succs, valid, ovf = jax.vmap(
+            lambda t: expand(t, dev))(structs)
+        svecs = jax.vmap(jax.vmap(lambda t: st.pack(t, jnp)))(succs)
+        fp_hi, fp_lo, inv_ok, con_ok = kernels.apply_stages(
+            bounds, stages, symmetry, succs, svecs, valid)
+        return {"svecs": svecs, "valid": valid, "overflow": ovf,
+                "fp_hi": fp_hi, "fp_lo": fp_lo, "inv_ok": inv_ok,
+                "con_ok": con_ok}
+
+    return step

@@ -559,12 +559,8 @@ class PagedShardEngine:
               carry.fail, carry.lvl, carry.levels, carry.cov))
         fail = int(np.bitwise_or.reduce(np.asarray(fail_d)))
         if fail:
-            parts = [decode_fail(fail & ~FAIL_ROUTE)] \
-                if fail & ~FAIL_ROUTE else []
-            if fail & FAIL_ROUTE:
-                parts.append("routing-buffer capacity exceeded")
             raise RuntimeError(
-                f"paged-shard search aborted: {'; '.join(parts)} "
+                f"paged-shard search aborted: {decode_fail(fail)} "
                 f"(caps={self.caps}, ndev={self.ndev}) — grow "
                 "PagedShardCapacities and rerun")
         n_states = int(np.asarray(n_states_d).sum())

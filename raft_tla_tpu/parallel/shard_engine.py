@@ -69,8 +69,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_tla_tpu.config import CheckConfig
 from raft_tla_tpu.device_engine import (
-    _EMPTY, _dedup_insert, BUCKET, FAIL_LEVEL, FAIL_PROBE, FAIL_STORE,
-    FAIL_WIDTH, decode_fail, _acc64_add, acc64_int, widen_legacy_n_trans)
+    _EMPTY, _dedup_insert, BUCKET, FAIL_LEVEL, FAIL_PROBE, FAIL_ROUTE,
+    FAIL_STORE, FAIL_WIDTH, decode_fail, _acc64_add, acc64_int,
+    widen_legacy_n_trans)
 from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import fingerprint as fpr
@@ -85,8 +86,6 @@ U32 = jnp.uint32
 _AXIS = "d"     # the frontier/fingerprint mesh axis (DP, SURVEY §2.9)
 _DCN = "dcn"    # outer mesh axis for multi-slice scale-out (SURVEY §2.9
 #                 comm-backend row: ICI within a slice, DCN across slices)
-# routing-buffer overflow (shard engine only; continues the FAIL_* bitmask)
-FAIL_ROUTE = 32
 
 
 @dataclasses.dataclass(frozen=True)
@@ -581,12 +580,8 @@ class ShardEngine:
               carry.fail, carry.lvl, carry.levels, carry.cov))
         fail = int(np.bitwise_or.reduce(np.asarray(fail_d)))
         if fail:
-            parts = [decode_fail(fail & ~FAIL_ROUTE)] \
-                if fail & ~FAIL_ROUTE else []
-            if fail & FAIL_ROUTE:
-                parts.append("routing-buffer capacity exceeded")
             raise RuntimeError(
-                f"sharded search aborted: {'; '.join(parts)} "
+                f"sharded search aborted: {decode_fail(fail)} "
                 f"(caps={self.caps}, ndev={self.ndev}) — grow "
                 "ShardCapacities and rerun")
         n_states = int(np.asarray(n_states_d).sum())

@@ -6,11 +6,15 @@ The streamed-engine v3 run reached 131.3M orbits into level 26 before the
 did not survive the environment reset.  This restarts the space on the
 DDD engine, whose exact dedup lives in host RAM (~15B-state capacity).
 
-Usage: python runs/elect5_ddd.py [resume]
+Usage: python runs/elect5_ddd.py [resume] [--route K]
 Checkpoints at runs/elect5ddd.ckpt every 15 min; stats stream appended to
-runs/elect5ddd.stats (one JSON line per flush/level).
+runs/elect5ddd.stats (one JSON line per flush/level).  ``--route K``
+switches to the EP-routed step (DDDCapacities.route_rows=K) —
+checkpoint-compatible either way (tests/test_ddd_engine.py::
+test_routed_checkpoint_crosses_step_switch).
 """
 
+import dataclasses
 import json
 import os
 import sys
@@ -37,14 +41,23 @@ CAPS = DDDCapacities(block=1 << 20, table=1 << 28, seg_rows=1 << 19,
 
 
 def main():
-    resume = CKPT if (len(sys.argv) > 1 and sys.argv[1] == "resume") \
-        else None
+    args = sys.argv[1:]
+    route = 0
+    if "--route" in args:
+        k = args.index("--route")
+        if k + 1 >= len(args) or not args[k + 1].isdigit():
+            sys.exit("usage: elect5_ddd.py [resume] [--route K]  "
+                     "(K = routed candidate slots per chunk, integer)")
+        route = int(args[k + 1])
+        del args[k:k + 2]
+    caps = dataclasses.replace(CAPS, route_rows=route) if route else CAPS
+    resume = CKPT if args and args[0] == "resume" else None
     sf = open(STATS, "a", buffering=1)
 
     def on_progress(s):
         sf.write(json.dumps(s) + "\n")
 
-    eng = DDDEngine(CFG, CAPS)
+    eng = DDDEngine(CFG, caps)
     r = eng.check(on_progress=on_progress, checkpoint=CKPT,
                   checkpoint_every_s=900.0, resume=resume)
     print(json.dumps({

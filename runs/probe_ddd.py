@@ -1,6 +1,6 @@
 """Time-boxed DDD-engine probes on the real chip.
 
-Usage: python runs/probe_ddd.py <workload> <deadline_s> <chunk>
+Usage: python runs/probe_ddd.py <workload> <deadline_s> <chunk> [route_rows]
   workload: ns  = north-star-shaped symmetric full-Next 3s/2v (bench probe)
             e5  = elect5-shaped symmetric 5s election t2/m2
             c4  = config #4: symmetric full-Next 5s/2v t2/l1/m2
@@ -38,9 +38,11 @@ WORKLOADS = {
 def main():
     wl, deadline, chunk = (sys.argv[1], float(sys.argv[2]),
                            int(sys.argv[3]))
+    route = int(sys.argv[4]) if len(sys.argv) > 4 else 0
     cfg = CheckConfig(symmetry=("Server",), chunk=chunk, **WORKLOADS[wl])
     eng = DDDEngine(cfg, DDDCapacities(block=1 << 20, table=1 << 26,
-                                       flush=1 << 23, levels=1 << 12))
+                                       flush=1 << 23, levels=1 << 12,
+                                       route_rows=route))
     stats: list = []
     r = eng.check(deadline_s=deadline, on_progress=stats.append)
     if len(stats) >= 2:
@@ -49,7 +51,8 @@ def main():
     else:
         d_orbits, d_wall = r.n_states, r.wall_s
     print(json.dumps({
-        "workload": wl, "chunk": chunk, "orbits": r.n_states,
+        "workload": wl, "chunk": chunk, "route_rows": route,
+        "orbits": r.n_states,
         "level": stats[-1]["level"] if stats else 0,
         "orbits_per_sec": round(d_orbits / max(d_wall, 1e-9), 1),
         "transitions": r.n_transitions,

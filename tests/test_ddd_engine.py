@@ -191,3 +191,158 @@ def test_deadline_stops_cleanly():
     assert not got.complete
     assert 1 <= got.n_states < 142538
     assert got.violation is None
+
+
+# -- EP-routed step (DDDCapacities.route_rows; SURVEY §2.9 EP row) ----------
+
+import dataclasses
+
+
+def _routed(caps, k):
+    return dataclasses.replace(caps, route_rows=k)
+
+
+def _n_lanes(cfg):
+    from raft_tla_tpu.models import spec as S
+    return cfg.chunk * len(S.action_table(cfg.bounds, cfg.spec))
+
+
+def test_routed_parity_with_dense():
+    """route_rows changes only where per-candidate work runs — counts,
+    levels, coverage and discovery order are byte-identical.  K = N/2
+    makes the slots genuinely contested (the realistic operating point:
+    fewer slots than lanes, no overflow), not just a stable re-ordering
+    of the full grid."""
+    dense = DDDEngine(CFG, CAPS).check()
+    for k in (_n_lanes(CFG), _n_lanes(CFG) // 2):
+        got = DDDEngine(CFG, _routed(CAPS, k)).check()
+        for f in ("n_states", "diameter", "levels", "n_transitions",
+                  "coverage", "complete"):
+            assert getattr(got, f) == getattr(dense, f), (k, f)
+        assert got.violation is None
+
+
+def test_routed_violation_truncation_exact():
+    from raft_tla_tpu.models import spec as S
+    from raft_tla_tpu.ops import msgbits as mb
+
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=4, max_dup=1)
+    cfg = CheckConfig(bounds=bounds, spec="election",
+                      invariants=("NaiveNoTwoLeaders",), chunk=64)
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3),
+        votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100),
+        msgs=tuple(sorted((m, 1) for m in
+                          (mb.rv_response(3, 1, 1, 2),))),
+    )
+    caps = DDDCapacities(block=1 << 12, table=1 << 17, flush=1 << 12,
+                         levels=64)
+    ref = DDDEngine(cfg, caps).check(init_override=start)
+    got = DDDEngine(cfg, _routed(caps, _n_lanes(cfg))) \
+        .check(init_override=start)
+    assert got.violation is not None
+    assert got.violation.invariant == ref.violation.invariant
+    assert got.n_states == ref.n_states          # refbfs-exact stop
+    assert got.n_transitions == ref.n_transitions
+    assert got.violation.trace == ref.violation.trace
+
+
+def test_routed_deadlock_and_symmetry():
+    cfg = CheckConfig(bounds=Bounds(n_servers=1, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=(), chunk=16,
+                      check_deadlock=True)
+    caps = DDDCapacities(block=64, table=1 << 12, flush=1 << 8, levels=64)
+    ref = DDDEngine(cfg, caps).check()
+    got = DDDEngine(cfg, _routed(caps, _n_lanes(cfg))).check()
+    assert got.violation is not None
+    assert got.violation.invariant == ref.violation.invariant
+    assert got.n_states == ref.n_states
+
+    sym = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      symmetry=("Server",), chunk=32)
+    got = DDDEngine(sym, _routed(CAPS, _n_lanes(sym))).check()
+    assert got.n_states == 1514      # refbfs-verified orbit count
+
+
+def test_routed_faithful_mode():
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2, history=True,
+                                    max_elections=4),
+                      spec="election",
+                      invariants=("NoTwoLeaders", "ElectionSafetyHist"),
+                      chunk=64)
+    caps = DDDCapacities(block=512, table=1 << 14, flush=1 << 11,
+                         levels=64)
+    dense = DDDEngine(cfg, caps).check()
+    got = DDDEngine(cfg, _routed(caps, _n_lanes(cfg))).check()
+    for f in ("n_states", "diameter", "levels", "n_transitions",
+              "coverage"):
+        assert getattr(got, f) == getattr(dense, f), f
+
+
+def test_routed_checkpoint_crosses_step_switch(tmp_path):
+    """route_rows stays out of the checkpoint digest: a dense snapshot
+    resumes on the routed step (and vice versa) with identical results —
+    the mid-campaign tuning DDDCapacities promises."""
+    straight = DDDEngine(CFG, CAPS).check()
+    ck = str(tmp_path / "ddd_route.ckpt")
+    DDDEngine(CFG, CAPS).check(checkpoint=ck, checkpoint_every_s=0.0)
+    resumed = DDDEngine(CFG, _routed(CAPS, _n_lanes(CFG))) \
+        .check(resume=ck)
+    assert resumed.n_states == straight.n_states
+    assert resumed.n_transitions == straight.n_transitions
+    assert resumed.coverage == straight.coverage
+
+
+def test_routed_budget_overflow_aborts_loudly():
+    with pytest.raises(RuntimeError, match="routing budget"):
+        DDDEngine(CFG, _routed(CAPS, 8)).check()
+
+
+def test_routed_violation_never_masked_by_budget():
+    """Sweeping route_rows across the seeded-violation universe: every
+    budget either aborts loudly (FAIL_ROUTE — candidates before the cut
+    may be lost) or reports EXACTLY the dense engine's violation with
+    dense-exact counts; a detected invariant violation outranks a
+    routing overflow (the dropped lanes provably lie past the cut)."""
+    from raft_tla_tpu.models import spec as S
+    from raft_tla_tpu.ops import msgbits as mb
+
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=4, max_dup=1)
+    cfg = CheckConfig(bounds=bounds, spec="election",
+                      invariants=("NaiveNoTwoLeaders",), chunk=64)
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3),
+        votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100),
+        msgs=tuple(sorted((m, 1) for m in
+                          (mb.rv_response(3, 1, 1, 2),))),
+    )
+    caps = DDDCapacities(block=1 << 12, table=1 << 17, flush=1 << 12,
+                         levels=64)
+    ref = DDDEngine(cfg, caps).check(init_override=start)
+    n_lanes = _n_lanes(cfg)
+    reported = 0
+    for k in (n_lanes // 16, n_lanes // 8, n_lanes // 4,
+              n_lanes // 2, n_lanes):
+        try:
+            got = DDDEngine(cfg, _routed(caps, k)) \
+                .check(init_override=start)
+        except RuntimeError as e:
+            assert "routing budget" in str(e)
+            continue
+        assert got.violation is not None
+        assert got.violation.invariant == ref.violation.invariant
+        assert got.n_states == ref.n_states
+        assert got.n_transitions == ref.n_transitions
+        assert got.violation.trace == ref.violation.trace
+        reported += 1
+    assert reported >= 1          # the sweep must exercise the report path

@@ -143,3 +143,58 @@ def test_differential_5server_north_star_universe():
     states = [random_pystate(rng, bounds) for _ in range(24)]
     states.append(interp.init_state(bounds))
     _diff_on_states(states, bounds, "full")
+
+
+def test_routed_step_matches_dense():
+    """build_step_routed (EP routing, SURVEY §2.9): the compacted stream
+    is exactly the dense step's valid lanes, in flat order, with
+    identical per-candidate values — and the budget overflow is loud."""
+    bounds = B3
+    rng = np.random.default_rng(17)
+    states = [random_pystate(rng, bounds) for _ in range(16)]
+    vecs = jnp.asarray(np.stack([interp.to_vec(s, bounds) for s in states]))
+    invs = ("NoTwoLeaders", "LogMatching")
+    for sym in ((), ("Server",)):
+        dense = jax.jit(kernels.build_step(bounds, "full", invs,
+                                           sym))(vecs)
+        A = dense["valid"].shape[1]
+        N = len(states) * A
+        routed = jax.jit(kernels.build_step_routed(
+            bounds, "full", invs, sym, k_rows=N))(vecs)
+        np.testing.assert_array_equal(dense["valid"], routed["valid"])
+        np.testing.assert_array_equal(dense["overflow"],
+                                      routed["overflow"])
+        fvalid = np.asarray(dense["valid"]).reshape(-1)
+        en = np.flatnonzero(fvalid)
+        cidx = np.asarray(routed["cidx"])
+        assert np.asarray(routed["cvalid"]).sum() == en.size
+        np.testing.assert_array_equal(cidx[:en.size], en)
+        assert (cidx[en.size:] == N).all()
+        assert not bool(routed["route_ovf"])
+        W = dense["svecs"].shape[-1]
+        np.testing.assert_array_equal(
+            np.asarray(routed["csvecs"])[:en.size],
+            np.asarray(dense["svecs"]).reshape(N, W)[en])
+        for dk, rk in (("fp_hi", "cfp_hi"), ("fp_lo", "cfp_lo"),
+                       ("con_ok", "ccon_ok")):
+            np.testing.assert_array_equal(
+                np.asarray(routed[rk])[:en.size],
+                np.asarray(dense[dk]).reshape(N)[en])
+        np.testing.assert_array_equal(
+            np.asarray(routed["cinv_ok"])[:en.size],
+            np.asarray(dense["inv_ok"]).reshape(N, len(invs))[en])
+    # a budget below the enabled count must flag, never silently drop
+    tight = jax.jit(kernels.build_step_routed(
+        bounds, "full", invs, k_rows=max(1, en.size // 2)))(vecs)
+    assert bool(tight["route_ovf"])
+    # row_ok: dead rows (stale padding / constraint-excluded parents)
+    # must not consume routing slots — only live rows' lanes compact
+    row_ok = np.arange(len(states)) % 2 == 0
+    masked = jax.jit(kernels.build_step_routed(
+        bounds, "full", invs, k_rows=N))(vecs, jnp.asarray(row_ok))
+    np.testing.assert_array_equal(masked["valid"], dense["valid"])
+    live = fvalid & np.repeat(row_ok, A)
+    en_live = np.flatnonzero(live)
+    assert np.asarray(masked["cvalid"]).sum() == en_live.size
+    np.testing.assert_array_equal(
+        np.asarray(masked["cidx"])[:en_live.size], en_live)

@@ -107,7 +107,7 @@ def test_routing_overflow_is_loud():
                                     max_log=0, max_msgs=2),
                       spec="election", invariants=(), chunk=64)
     caps = ShardCapacities(n_states=1 << 12, levels=64, send=1)
-    with pytest.raises(RuntimeError, match="capacity"):
+    with pytest.raises(RuntimeError, match="routing budget"):
         ShardEngine(cfg, make_mesh(8), caps).check()
 
 
