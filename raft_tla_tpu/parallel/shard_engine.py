@@ -664,3 +664,217 @@ def check(config: CheckConfig, mesh: Mesh | None = None,
     """One-shot convenience mirroring the other engines' ``check``."""
     return _cached_engine(config, mesh if mesh is not None else make_mesh(),
                           caps or ShardCapacities()).check(**kw)
+
+
+def reshard_checkpoint(config: CheckConfig, caps_src: ShardCapacities,
+                       src_path: str, dst_path: str, ndev_dst: int,
+                       caps_dst: ShardCapacities | None = None,
+                       init_override: interp.PyState | None = None) -> dict:
+    """Rewrite a shard-engine checkpoint for a different mesh size.
+
+    A snapshot's FP-ownership map (``owner = fp_hi % ndev``) and its
+    global discovery ids (``dev * Ncap + row``) are baked into the saved
+    carry, so the digest pins the mesh size — without this loader, a
+    pod-size change discards a multi-hour run.  The resharder rebuilds
+    the carry host-side from first principles:
+
+    - every stored state's dedup key is **recomputed** from its packed
+      row (the fp/orbit pipeline is deterministic, so keys are
+      bit-identical to the original run's) and the state moves to its
+      new owner ``hi % ndev_dst``;
+    - the already-expanded prefix of the current BFS window (``c``
+      lockstep chunks) is **promoted into the done region** — expanded
+      is expanded, whichever device now holds the row — so mid-level
+      snapshots reshard exactly: the new window holds only unexpanded
+      rows, ``c`` resets to 0, and level accounting (``levels``, the
+      post-window next-level states) is unchanged;
+    - parent links are remapped old-gid -> new-gid (traces survive);
+    - per-device fingerprint tables are rebuilt by replaying the
+      engine's own ``_dedup_insert`` over each new device's keys in
+      its new discovery order;
+    - counters that only ever report as mesh-wide sums (``n_trans``,
+      ``cov``) are totalled onto device 0.
+
+    ``caps_dst`` may also grow ``n_states``/``table`` (rescuing a run
+    near FAIL_STORE/FAIL_PROBE); it defaults to ``caps_src``.  Refuses
+    runs that already stopped, failed, or found a violation.  Returns a
+    summary dict (per-device state counts, window sizes).
+    """
+    caps_dst = caps_dst or caps_src
+    bounds = config.bounds
+    lay = st.Layout.of(bounds)
+    A = len(S.action_table(bounds, config.spec))
+    B = config.chunk
+    W = lay.width
+    Ncap_s, Ncap_d = caps_src.n_states, caps_dst.n_states
+    if ndev_dst * Ncap_d > 2**31 - 1:
+        raise ValueError("ndev_dst * n_states exceeds the int32 global-id "
+                         "address space")
+
+    init_py = init_override if init_override is not None \
+        else interp.init_state(bounds)
+    init_vec = interp.to_vec(init_py, bounds)
+    hi0, lo0 = sym_mod.init_fingerprint(config, init_py, init_vec)
+    init_key = (int(hi0), int(lo0))
+
+    with np.load(src_path) as z:
+        arrs = [np.asarray(z[f"c{i}"])
+                for i in range(len(SCarry._fields))]
+        stored_digest = int(z["config_digest"])
+    arrs = widen_legacy_n_trans(arrs, SCarry._fields)
+    src = SCarry(*arrs)
+    nd_src = src.n_states.shape[0]
+    want = ckpt.config_digest(config, caps_src, init_key + (nd_src,))
+    if stored_digest != np.uint64(want):
+        raise ValueError(
+            f"checkpoint digest mismatch: {src_path} was not written by "
+            f"this config/caps on a {nd_src}-device mesh")
+    if bool(np.asarray(src.stop)):
+        raise ValueError("run already complete (stop flag set) — "
+                         "nothing to reshard")
+    if int(np.bitwise_or.reduce(src.fail)) != 0:
+        raise ValueError(f"refusing to reshard a failed run: "
+                         f"{decode_fail(int(np.bitwise_or.reduce(src.fail)))}")
+    if (src.viol_g >= 0).any():
+        raise ValueError("refusing to reshard a run with a recorded "
+                         "violation")
+
+    # -- recompute every stored state's dedup key (batched, jitted) --------
+    consts_j = jnp.asarray(fpr.lane_constants(W))
+    faithful = "allLogs" in lay.shapes
+    if config.symmetry:
+        orbit = sym_mod.build_orbit_fp(bounds, tuple(config.symmetry),
+                                       consts_j, faithful)
+
+        @jax.jit
+        def fp_batch(vecs):
+            structs = jax.vmap(lambda v: st.unpack(v, lay, jnp))(vecs)
+            return orbit(structs)
+    else:
+        @jax.jit
+        def fp_batch(vecs):
+            return fpr.fingerprint(vecs, consts_j, jnp)
+
+    # -- live rows in (group, old_dev, row) order, fully vectorized --------
+    # group 0: done + expanded window prefix; 1: unexpanded window;
+    # 2: next-level states.  Everything below is array-at-a-time so a
+    # flagship-scale (10^8-row) rescue stays in numpy, not Python loops.
+    store = src.store.reshape(nd_src, Ncap_s, W)
+    c_cur = int(np.asarray(src.c))
+    devs_l, rows_l, grp_l = [], [], []
+    for d in range(nd_src):
+        ns_d = int(src.n_states[d])
+        ls_d, le_d = int(src.lvl_start[d]), int(src.lvl_end[d])
+        ec_d = min(c_cur * B, le_d - ls_d)       # expanded window prefix
+        g = np.empty((ns_d,), np.int8)
+        g[:ls_d + ec_d] = 0
+        g[ls_d + ec_d:le_d] = 1
+        g[le_d:] = 2
+        devs_l.append(np.full((ns_d,), d, np.int64))
+        rows_l.append(np.arange(ns_d, dtype=np.int64))
+        grp_l.append(g)
+    devs = np.concatenate(devs_l)
+    rows = np.concatenate(rows_l)
+    grp = np.concatenate(grp_l)
+    M = devs.size
+    if M == 0:
+        raise ValueError("empty checkpoint")
+    # concat order is dev-major with ascending rows, so a stable sort on
+    # group alone yields (group, dev, row) lexicographic order
+    order = np.argsort(grp, kind="stable")
+    devs, rows, grp = devs[order], rows[order], grp[order]
+    vecs_all = np.ascontiguousarray(store[devs, rows])
+
+    keys_hi = np.empty((M,), np.uint32)
+    keys_lo = np.empty((M,), np.uint32)
+    CH = 8192
+    for o in range(0, M, CH):
+        h, l = fp_batch(jnp.asarray(vecs_all[o:o + CH], jnp.int32))
+        keys_hi[o:o + CH] = np.asarray(h)
+        keys_lo[o:o + CH] = np.asarray(l)
+
+    # -- assign new owners, preserving sequence order per owner ------------
+    owner_of = (keys_hi % np.uint32(ndev_dst)).astype(np.int64)
+    counts = np.bincount(owner_of, minlength=ndev_dst)
+    ns_new = counts.astype(np.int32)
+    if (ns_new > Ncap_d).any():
+        raise ValueError(
+            f"caps_dst.n_states={Ncap_d} too small: a device would hold "
+            f"{int(ns_new.max())} states — grow caps_dst")
+    perm = np.argsort(owner_of, kind="stable")   # owner-major, seq order
+    offsets = np.cumsum(counts) - counts
+    local_idx = np.empty((M,), np.int64)
+    local_idx[perm] = np.arange(M) - np.repeat(offsets, counts)
+    new_gid = owner_of * Ncap_d + local_idx
+    gid_map = np.full((nd_src * Ncap_s,), -1, np.int64)
+    gid_map[devs * Ncap_s + rows] = new_gid
+    ls_new = np.bincount(owner_of[grp == 0],
+                         minlength=ndev_dst).astype(np.int32)
+    le_new = ls_new + np.bincount(owner_of[grp == 1],
+                                  minlength=ndev_dst).astype(np.int32)
+
+    # -- rebuild the sharded leaves (vectorized scatters) ------------------
+    par_src = src.parent.reshape(nd_src, Ncap_s)
+    lane_src = src.lane.reshape(nd_src, Ncap_s)
+    con_src = src.conflag.reshape(nd_src, Ncap_s)
+    store_new = np.zeros((ndev_dst * Ncap_d, W), np.int32)
+    parent_new = np.full((ndev_dst * Ncap_d,), -1, np.int32)
+    lane_new = np.full((ndev_dst * Ncap_d,), -1, np.int32)
+    con_new = np.zeros((ndev_dst * Ncap_d,), bool)
+    store_new[new_gid] = vecs_all
+    p_old = par_src[devs, rows]
+    parent_new[new_gid] = np.where(p_old >= 0, gid_map[np.maximum(p_old, 0)],
+                                   -1).astype(np.int32)
+    lane_new[new_gid] = lane_src[devs, rows]
+    con_new[new_gid] = con_src[devs, rows]
+    TBd = caps_dst.table // BUCKET
+    tbl_hi_new = np.full((ndev_dst * TBd, BUCKET), _EMPTY, np.uint32)
+    tbl_lo_new = np.full((ndev_dst * TBd, BUCKET), _EMPTY, np.uint32)
+    ins = jax.jit(_dedup_insert)
+    for o in range(ndev_dst):
+        th = jnp.asarray(tbl_hi_new[o * TBd:(o + 1) * TBd])
+        tl = jnp.asarray(tbl_lo_new[o * TBd:(o + 1) * TBd])
+        sl = perm[offsets[o]:offsets[o] + counts[o]]  # new local order
+        for jo in range(0, sl.size, 4096):
+            s2 = sl[jo:jo + 4096]
+            th, tl, is_new, pf = ins(
+                th, tl, jnp.asarray(keys_hi[s2]), jnp.asarray(keys_lo[s2]),
+                jnp.ones((s2.size,), bool))
+            if bool(pf) or not bool(np.asarray(is_new).all()):
+                raise RuntimeError(
+                    "table rebuild failed (probe overflow or duplicate "
+                    "key) — grow caps_dst.table")
+        tbl_hi_new[o * TBd:(o + 1) * TBd] = np.asarray(th)
+        tbl_lo_new[o * TBd:(o + 1) * TBd] = np.asarray(tl)
+
+    n_trans_tot = sum(
+        acc64_int(src.n_trans.reshape(nd_src, 2)[d]) for d in range(nd_src))
+    n_trans_new = np.zeros((ndev_dst * 2,), np.uint32)
+    n_trans_new[0] = np.uint32(n_trans_tot & 0xFFFFFFFF)
+    n_trans_new[1] = np.uint32(n_trans_tot >> 32)
+    cov_new = np.zeros((ndev_dst * A,), np.int32)
+    cov_new[:A] = src.cov.reshape(nd_src, A).sum(axis=0)
+
+    win = (le_new - ls_new).astype(np.int64)
+    n_chunks = int(max(1, ((win + B - 1) // B).max()))
+    dst = SCarry(
+        store=store_new, parent=parent_new, lane=lane_new,
+        conflag=con_new, tbl_hi=tbl_hi_new, tbl_lo=tbl_lo_new,
+        n_states=ns_new, lvl_start=ls_new, lvl_end=le_new,
+        viol_g=np.full((ndev_dst,), -1, np.int32),
+        viol_i=np.zeros((ndev_dst,), np.int32),
+        n_trans=n_trans_new, cov=cov_new,
+        fail=np.zeros((ndev_dst,), np.int32),
+        levels=np.asarray(src.levels), lvl=np.asarray(src.lvl),
+        c=np.int32(0), n_chunks=np.int32(n_chunks),
+        stop=np.bool_(False))
+    ckpt.atomic_savez(
+        dst_path,
+        **{f"c{i}": np.asarray(x) for i, x in enumerate(dst)},
+        config_digest=np.uint64(ckpt.config_digest(
+            config, caps_dst, init_key + (ndev_dst,))))
+    return {"ndev_src": nd_src, "ndev_dst": ndev_dst,
+            "n_states": int(ns_new.sum()),
+            "per_device": ns_new.tolist(),
+            "window": win.tolist(),
+            "promoted_expanded": c_cur > 0}

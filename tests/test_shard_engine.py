@@ -149,3 +149,78 @@ def test_slice_mesh_checkpoint_portable_from_1d(tmp_path):
     assert got.n_states == straight.n_states
     assert got.levels == straight.levels
     assert got.n_transitions == straight.n_transitions
+
+
+def test_reshard_checkpoint_across_mesh_sizes(tmp_path):
+    """A mid-run 2-device snapshot resharded to 4, 1, and (with grown
+    caps) 8 devices resumes with oracle-exact results — a pod-size
+    change no longer discards a run.  Also exercises the mid-level
+    promotion (expanded window prefix moves to the done region)."""
+    from raft_tla_tpu.parallel.shard_engine import reshard_checkpoint
+
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=64)
+    ref = refbfs.check(cfg)
+    ck = str(tmp_path / "m2.ckpt")
+    ShardEngine(cfg, make_mesh(2), CAPS, seg_chunks=8).check(
+        checkpoint=ck, checkpoint_every_s=0.0)
+    for nd in (4, 1):
+        out = str(tmp_path / f"m{nd}.ckpt")
+        info = reshard_checkpoint(cfg, CAPS, ck, out, nd)
+        assert info["ndev_src"] == 2 and info["ndev_dst"] == nd
+        got = ShardEngine(cfg, make_mesh(nd), CAPS).check(resume=out)
+        assert got.n_states == ref.n_states
+        assert got.levels == ref.levels
+        assert got.n_transitions == ref.n_transitions
+        assert sum(got.coverage.values()) == sum(ref.coverage.values())
+        assert got.violation is None
+    big = ShardCapacities(n_states=1 << 13, levels=64)
+    out = str(tmp_path / "m8big.ckpt")
+    reshard_checkpoint(cfg, CAPS, ck, out, 8, caps_dst=big)
+    got = ShardEngine(cfg, make_mesh(8), big).check(resume=out)
+    assert got.n_states == ref.n_states
+    assert got.levels == ref.levels
+
+
+def test_reshard_symmetric_run(tmp_path):
+    """Resharding recomputes ORBIT keys when the run has SYMMETRY; the
+    resumed orbit counts must stay exact."""
+    from raft_tla_tpu.parallel.shard_engine import reshard_checkpoint
+
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      symmetry=("Server",), chunk=64)
+    ref = refbfs.check(cfg)
+    assert ref.n_states == 1514
+    ck = str(tmp_path / "sym2.ckpt")
+    ShardEngine(cfg, make_mesh(2), CAPS, seg_chunks=8).check(
+        checkpoint=ck, checkpoint_every_s=0.0)
+    out = str(tmp_path / "sym8.ckpt")
+    reshard_checkpoint(cfg, CAPS, ck, out, 8)
+    got = ShardEngine(cfg, make_mesh(8), CAPS).check(resume=out)
+    assert got.n_states == ref.n_states
+    assert got.levels == ref.levels
+    assert got.n_transitions == ref.n_transitions
+
+
+def test_reshard_refuses_finished_and_wrong_digest(tmp_path):
+    from raft_tla_tpu.parallel.shard_engine import reshard_checkpoint
+
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=64)
+    ck = str(tmp_path / "m2.ckpt")
+    ShardEngine(cfg, make_mesh(2), CAPS, seg_chunks=8).check(
+        checkpoint=ck, checkpoint_every_s=0.0)
+    other = CheckConfig(bounds=cfg.bounds, spec="election",
+                        invariants=(), chunk=64)
+    with pytest.raises(ValueError, match="digest"):
+        reshard_checkpoint(other, CAPS, ck, str(tmp_path / "x.ckpt"), 4)
+    tiny = ShardCapacities(n_states=1 << 4, levels=64)
+    with pytest.raises(ValueError, match="n_states"):
+        reshard_checkpoint(cfg, CAPS, ck, str(tmp_path / "y.ckpt"), 1,
+                           caps_dst=tiny)
