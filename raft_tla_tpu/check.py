@@ -92,6 +92,24 @@ def build_argparser() -> argparse.ArgumentParser:
                         "default: derived from --cap, at most 4M)")
     p.add_argument("--devices", type=int, default=None,
                    help="mesh size for --engine shard (default: all)")
+    p.add_argument("--seg-chunks", type=int, default=256,
+                   help="initial chunk expansions per device dispatch for "
+                        "--engine shard (the adaptive pacer tunes it from "
+                        "there; small values force frequent segment "
+                        "boundaries, hence more checkpoint opportunities)")
+    p.add_argument("--route", type=int, default=0, metavar="K",
+                   help="--engine ddd only: EP-routed step with K "
+                        "compacted candidate slots per chunk (the "
+                        "expensive orbit/invariant stages then run on K "
+                        "rows instead of chunk*A; size from the "
+                        "route_peak stat of a dense run; overflow aborts "
+                        "loudly; 0 = dense step)")
+    p.add_argument("--reshard-to", type=int, default=None, metavar="NDEV",
+                   help="--engine shard only: instead of searching, "
+                        "rewrite the --resume checkpoint for an "
+                        "NDEV-device mesh, save it to the --checkpoint "
+                        "path, print a summary, and exit (a pod-size "
+                        "change no longer discards a run)")
     p.add_argument("--slices", type=int, default=None,
                    help="multi-slice scale-out for shard/pagedshard: build "
                         "a 2-D (dcn, ici) mesh of N slices x (devices/N) "
@@ -276,21 +294,26 @@ def _make_cli_mesh(args):
     return make_slice_mesh(args.slices, nd // args.slices)
 
 
+def _force_cpu(args):
+    """Honor ``--cpu`` (one definition for every CLI path): switch the
+    backend, or warn when backends are already initialized — never
+    silently run on the accelerator."""
+    if not args.cpu:
+        return
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        if args.devices:
+            jax.config.update("jax_num_cpu_devices", args.devices)
+    except RuntimeError:
+        if jax.default_backend() != "cpu":
+            print("Warning: --cpu requested but JAX backends are "
+                  f"already initialized on {jax.default_backend()!r}; "
+                  "proceeding there", file=sys.stderr)
+
+
 def _run(args, config):
-    if args.cpu:
-        import jax
-        try:
-            jax.config.update("jax_platforms", "cpu")
-            if args.devices:
-                jax.config.update("jax_num_cpu_devices", args.devices)
-        except RuntimeError:
-            # Backends already initialized (e.g. embedded in a process that
-            # ran a jax op); honoring --cpu is impossible now — say so
-            # rather than silently running on the accelerator.
-            if jax.default_backend() != "cpu":
-                print("Warning: --cpu requested but JAX backends are "
-                      f"already initialized on {jax.default_backend()!r}; "
-                      "proceeding there", file=sys.stderr)
+    _force_cpu(args)
     if args.engine == "ref":
         from raft_tla_tpu.models import refbfs
         return refbfs.check(config)
@@ -337,9 +360,11 @@ def _run(args, config):
         # candidate stream (chunk * action fan-out)
         A = len(S.action_table(config.bounds, config.spec))
         seg_rows = max(1 << 19, 2 * args.chunk * A)
+        if args.route and args.route > seg_rows:
+            seg_rows = args.route
         eng = DDDEngine(config, DDDCapacities(
             block=1 << 20, table=table, seg_rows=seg_rows,
-            levels=args.levels))
+            levels=args.levels, route_rows=args.route))
         return eng.check(on_progress=_stats_cb(args),
                          checkpoint=args.checkpoint,
                          checkpoint_every_s=args.checkpoint_every,
@@ -350,7 +375,8 @@ def _run(args, config):
         mesh = _make_cli_mesh(args)
         eng = ShardEngine(config, mesh,
                           ShardCapacities(n_states=args.cap,
-                                          levels=args.levels))
+                                          levels=args.levels),
+                          seg_chunks=args.seg_chunks)
         return eng.check(checkpoint=args.checkpoint,
                          checkpoint_every_s=args.checkpoint_every,
                          resume=args.resume, on_progress=_stats_cb(args))
@@ -437,21 +463,38 @@ def main(argv=None) -> int:
                   "--simulate mode (liveness needs exhaustive search)",
                   file=sys.stderr)
             return EXIT_ERROR
-        if args.cpu:
-            import jax
-            try:
-                jax.config.update("jax_platforms", "cpu")
-            except RuntimeError:
-                if jax.default_backend() != "cpu":
-                    print("Warning: --cpu requested but JAX backends are "
-                          "already initialized on "
-                          f"{jax.default_backend()!r}; proceeding there",
-                          file=sys.stderr)
+        _force_cpu(args)
         try:
             return _simulate(args, config)
         except Exception as e:
             print(f"Error: {e}", file=sys.stderr)
             return EXIT_ERROR
+
+    if args.reshard_to is not None:
+        if args.engine != "shard":
+            print("Error: --reshard-to requires --engine shard",
+                  file=sys.stderr)
+            return EXIT_ERROR
+        if not args.resume or not args.checkpoint:
+            print("Error: --reshard-to needs --resume SRC and "
+                  "--checkpoint DST", file=sys.stderr)
+            return EXIT_ERROR
+        _force_cpu(args)
+        from raft_tla_tpu.parallel.shard_engine import (ShardCapacities,
+                                                        reshard_checkpoint)
+        try:
+            info = reshard_checkpoint(
+                config, ShardCapacities(n_states=args.cap,
+                                        levels=args.levels),
+                args.resume, args.checkpoint, args.reshard_to)
+        except Exception as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return EXIT_ERROR
+        print(f"resharded {info['ndev_src']} -> {info['ndev_dst']} "
+              f"devices: {info['n_states']} states, per-device "
+              f"{info['per_device']}, window {info['window']} -> "
+              f"{args.checkpoint}")
+        return EXIT_OK
 
     t0 = time.monotonic()
     try:

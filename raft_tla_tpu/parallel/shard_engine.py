@@ -785,13 +785,19 @@ def reshard_checkpoint(config: CheckConfig, caps_src: ShardCapacities,
     devs, rows, grp = devs[order], rows[order], grp[order]
     vecs_all = np.ascontiguousarray(store[devs, rows])
 
-    keys_hi = np.empty((M,), np.uint32)
-    keys_lo = np.empty((M,), np.uint32)
+    # fixed-size batches (last one padded) — one jit compile, not one per
+    # ragged tail size
     CH = 8192
-    for o in range(0, M, CH):
-        h, l = fp_batch(jnp.asarray(vecs_all[o:o + CH], jnp.int32))
+    Mp = -(-M // CH) * CH
+    vecs_pad = np.zeros((Mp, W), np.int32)
+    vecs_pad[:M] = vecs_all
+    keys_hi = np.empty((Mp,), np.uint32)
+    keys_lo = np.empty((Mp,), np.uint32)
+    for o in range(0, Mp, CH):
+        h, l = fp_batch(jnp.asarray(vecs_pad[o:o + CH]))
         keys_hi[o:o + CH] = np.asarray(h)
         keys_lo[o:o + CH] = np.asarray(l)
+    keys_hi, keys_lo = keys_hi[:M], keys_lo[:M]
 
     # -- assign new owners, preserving sequence order per owner ------------
     owner_of = (keys_hi % np.uint32(ndev_dst)).astype(np.int64)
@@ -835,12 +841,18 @@ def reshard_checkpoint(config: CheckConfig, caps_src: ShardCapacities,
         th = jnp.asarray(tbl_hi_new[o * TBd:(o + 1) * TBd])
         tl = jnp.asarray(tbl_lo_new[o * TBd:(o + 1) * TBd])
         sl = perm[offsets[o]:offsets[o] + counts[o]]  # new local order
-        for jo in range(0, sl.size, 4096):
-            s2 = sl[jo:jo + 4096]
-            th, tl, is_new, pf = ins(
-                th, tl, jnp.asarray(keys_hi[s2]), jnp.asarray(keys_lo[s2]),
-                jnp.ones((s2.size,), bool))
-            if bool(pf) or not bool(np.asarray(is_new).all()):
+        IB = 4096
+        for jo in range(0, sl.size, IB):
+            s2 = sl[jo:jo + IB]
+            kh = np.full((IB,), 0, np.uint32)
+            kl = np.full((IB,), 0, np.uint32)
+            act = np.zeros((IB,), bool)
+            kh[:s2.size] = keys_hi[s2]
+            kl[:s2.size] = keys_lo[s2]
+            act[:s2.size] = True       # fixed batch shape: one compile
+            th, tl, is_new, pf = ins(th, tl, jnp.asarray(kh),
+                                     jnp.asarray(kl), jnp.asarray(act))
+            if bool(pf) or not bool(np.asarray(is_new)[:s2.size].all()):
                 raise RuntimeError(
                     "table rebuild failed (probe overflow or duplicate "
                     "key) — grow caps_dst.table")
@@ -855,6 +867,18 @@ def reshard_checkpoint(config: CheckConfig, caps_src: ShardCapacities,
     cov_new = np.zeros((ndev_dst * A,), np.int32)
     cov_new[:A] = src.cov.reshape(nd_src, A).sum(axis=0)
 
+    # the levels array is caps.levels long — resize to caps_dst (the
+    # digest is written for caps_dst, so a mismatched length would
+    # silently clamp deep-level accounting)
+    lvl_cur = int(np.asarray(src.lvl))
+    if caps_dst.levels <= lvl_cur + 1:
+        raise ValueError(
+            f"caps_dst.levels={caps_dst.levels} too small: the run is "
+            f"already at BFS level {lvl_cur}")
+    levels_new = np.zeros((caps_dst.levels,), np.int32)
+    n_keep = min(caps_src.levels, caps_dst.levels)
+    levels_new[:n_keep] = np.asarray(src.levels)[:n_keep]
+
     win = (le_new - ls_new).astype(np.int64)
     n_chunks = int(max(1, ((win + B - 1) // B).max()))
     dst = SCarry(
@@ -865,7 +889,7 @@ def reshard_checkpoint(config: CheckConfig, caps_src: ShardCapacities,
         viol_i=np.zeros((ndev_dst,), np.int32),
         n_trans=n_trans_new, cov=cov_new,
         fail=np.zeros((ndev_dst,), np.int32),
-        levels=np.asarray(src.levels), lvl=np.asarray(src.lvl),
+        levels=levels_new, lvl=np.asarray(src.lvl),
         c=np.int32(0), n_chunks=np.int32(n_chunks),
         stop=np.bool_(False))
     ckpt.atomic_savez(

@@ -176,8 +176,8 @@ def test_reshard_checkpoint_across_mesh_sizes(tmp_path):
         assert got.n_transitions == ref.n_transitions
         assert sum(got.coverage.values()) == sum(ref.coverage.values())
         assert got.violation is None
-    big = ShardCapacities(n_states=1 << 13, levels=64)
-    out = str(tmp_path / "m8big.ckpt")
+    big = ShardCapacities(n_states=1 << 13, levels=96)  # grown store AND
+    out = str(tmp_path / "m8big.ckpt")                  # levels array
     reshard_checkpoint(cfg, CAPS, ck, out, 8, caps_dst=big)
     got = ShardEngine(cfg, make_mesh(8), big).check(resume=out)
     assert got.n_states == ref.n_states
