@@ -581,5 +581,10 @@ def _check_liveness(args, config, props) -> int:
     return EXIT_OK
 
 
-if __name__ == "__main__":
+def entry() -> None:
+    """Console-script entry point (pyproject ``raft-tla-check``)."""
     sys.exit(main())
+
+
+if __name__ == "__main__":
+    entry()

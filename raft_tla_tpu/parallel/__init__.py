@@ -1,2 +1,7 @@
 from raft_tla_tpu.parallel.shard_engine import (  # noqa: F401
-    ShardCapacities, ShardEngine, check, make_mesh)
+    ShardCapacities, ShardEngine, check, make_mesh, make_slice_mesh,
+    reshard_checkpoint)
+from raft_tla_tpu.parallel.paged_shard_engine import (  # noqa: F401
+    PagedShardCapacities, PagedShardEngine)
+from raft_tla_tpu.parallel.cp_expand import (  # noqa: F401
+    build_cp_expand, build_cp_step, cp_lane_count, cp_lane_map)
