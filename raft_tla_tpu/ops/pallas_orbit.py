@@ -42,6 +42,7 @@ tests/test_pallas_orbit.py):
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -55,7 +56,8 @@ from raft_tla_tpu.ops.pallas_fp import fmix_i32, i32_const
 from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym
 
-_BLOCK_ROWS = 256
+_BLOCK_ROWS = 128    # per-block VMEM stack scales with R; 256 overflowed
+#                      the 16M scoped-vmem limit on a real v5e
 
 # fields whose VALUES change under a server relabeling (everything else
 # only moves between lanes, which the permuted-constants trick absorbs)
@@ -188,7 +190,11 @@ def _build_kernel(bounds: Bounds):
                                | (dst2 << d_sh), 0)
                 lo = jnp.where(occ, lo, 0)
                 ct = jnp.where(occ, ct, 0)
-                ks.append((~occ).astype(jnp.int32))
+                # int32 select, NOT a bool cast: Mosaic folds
+                # (~occ).astype(int32) back to an i1 vector, and the
+                # sort network's == on i1 fails to legalize on real
+                # TPUs ('arith.cmpi' on vector<8x128xi1>)
+                ks.append(jnp.where(occ, jnp.int32(0), jnp.int32(1)))
                 hs.append(hi)
                 ls.append(lo)
                 cs.append(ct)
@@ -213,8 +219,8 @@ def _build_kernel(bounds: Bounds):
             take = (fh < bh) | ((fh == bh) & (fl < bl))
             best_hi = jnp.where(take, fhi, best_hi)
             best_lo = jnp.where(take, flo, best_lo)
-        hi_ref[...] = best_hi
-        lo_ref[...] = best_lo
+        hi_ref[...] = best_hi[:, None]
+        lo_ref[...] = best_lo[:, None]
 
     return kernel, lay.width, perms
 
@@ -242,13 +248,26 @@ def _orbit_call(vecs, bounds, interpret=False):
         in_specs=[pl.BlockSpec((R, W), lambda i: (i, 0)),
                   pl.BlockSpec((P, 2, W), lambda i: (0, 0, 0)),
                   pl.BlockSpec((2, W), lambda i: (0, 0))],
-        out_specs=[pl.BlockSpec((R,), lambda i: (i,)),
-                   pl.BlockSpec((R,), lambda i: (i,))],
-        out_shape=[jax.ShapeDtypeStruct((v.shape[0],), jnp.int32),
-                   jax.ShapeDtypeStruct((v.shape[0],), jnp.int32)],
+        # outputs are column vectors [Npad, 1] with (R, 1) blocks: 1-D
+        # s32 outputs carry XLA layout tiling T(1024), which Mosaic
+        # rejects for R != 1024 blocks — and R = 1024 overflows the
+        # scoped-vmem stack; the 2-D column form tiles (8, 128) with a
+        # lane dim equal to the array's, which both sides accept
+        out_specs=[pl.BlockSpec((R, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((R, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((v.shape[0], 1), jnp.int32),
+                   jax.ShapeDtypeStruct((v.shape[0], 1), jnp.int32)],
         interpret=interpret,
     )(v.astype(jnp.int32), cp, cr)
-    return (hi[:N].astype(jnp.uint32), lo[:N].astype(jnp.uint32))
+    return (hi.reshape(-1)[:N].astype(jnp.uint32),
+            lo.reshape(-1)[:N].astype(jnp.uint32))
+
+
+# Mosaic's scoped-vmem kernel stack grows with the unrolled permutation
+# count: measured 73.4M at P = 120 (5 servers) against the 16M limit on a
+# real v5e, while P = 6 (3 servers) compiles and runs bit-identically.
+# Beyond this bound the builder declines and callers use the scan path.
+_MAX_COMPILED_PERMS = 24
 
 
 def build_orbit_fp(bounds: Bounds, axes: tuple, faithful: bool,
@@ -259,6 +278,9 @@ def build_orbit_fp(bounds: Bounds, axes: tuple, faithful: bool,
         return None
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if not interpret and math.factorial(bounds.n_servers) \
+            > _MAX_COMPILED_PERMS:
+        return None
 
     def orbit_fp(vecs):
         return _orbit_call(vecs, bounds, interpret)

@@ -1,7 +1,25 @@
 from raft_tla_tpu.parallel.shard_engine import (  # noqa: F401
     ShardCapacities, ShardEngine, check, make_mesh, make_slice_mesh,
     reshard_checkpoint)
-from raft_tla_tpu.parallel.paged_shard_engine import (  # noqa: F401
-    PagedShardCapacities, PagedShardEngine)
-from raft_tla_tpu.parallel.cp_expand import (  # noqa: F401
-    build_cp_expand, build_cp_step, cp_lane_count, cp_lane_map)
+
+# The paged-shard engine (pulls utils.native: a g++ build on first use)
+# and the CP expansion load lazily — importing the package stays as
+# cheap as the repo's lazy-import layering everywhere else assumes.
+_LAZY = {
+    "PagedShardCapacities": "paged_shard_engine",
+    "PagedShardEngine": "paged_shard_engine",
+    "build_cp_expand": "cp_expand",
+    "build_cp_step": "cp_expand",
+    "cp_lane_count": "cp_expand",
+    "cp_lane_map": "cp_expand",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(
+            f"raft_tla_tpu.parallel.{_LAZY[name]}")
+        return getattr(mod, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

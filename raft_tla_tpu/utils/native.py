@@ -33,8 +33,15 @@ def _build() -> str | None:
     # The library file is named by the source hash: freshness is content-
     # based (mtimes lie after a fresh clone), and concurrent builders race
     # benignly — both produce identical bytes and the os.replace is atomic.
-    with open(_SRC, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError as e:
+        # wheel installs ship the package without the sibling native/
+        # tree — the NumPy fallback serves them (same results, slower)
+        print(f"native source unavailable ({e}); using NumPy fallback",
+              file=sys.stderr)
+        return None
     lib = os.path.join(_LIB_DIR, f"libraft_host-{digest}.so")
     if os.path.exists(lib):
         return lib

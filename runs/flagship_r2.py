@@ -1,0 +1,54 @@
+"""Round-2 flagship re-verification: the reference raft.cfg universe
+(3s/2v, full `Next`, t2/l1/m2, SYMMETRY Server), exhaustive, single chip.
+
+Round 1 completed this space in ~6.4 h (94,396,461 orbits, diameter 57,
+4 invariants hold).  This rerun validates the round-2 performance work
+end-to-end: same verdicts, same counts, measured wall clock.
+
+Usage: python runs/flagship_r2.py [resume]
+Stats appended to runs/flagship_r2.stats; checkpoint every 5 min.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.paged_engine import PagedCapacities, PagedEngine
+
+RUNS = os.path.dirname(os.path.abspath(__file__))
+CKPT = os.path.join(RUNS, "flagship_r2.ckpt")
+STATS = os.path.join(RUNS, "flagship_r2.stats")
+
+CFG = CheckConfig(
+    bounds=Bounds(n_servers=3, n_values=2, max_term=2, max_log=1,
+                  max_msgs=2, max_dup=1),
+    spec="full",
+    invariants=("NoTwoLeaders", "LogMatching", "CommittedWithinLog",
+                "LeaderCompleteness"),
+    symmetry=("Server",), chunk=2048)
+
+CAPS = PagedCapacities(ring=1 << 23, table=1 << 28, levels=128)
+
+
+def main():
+    resume = CKPT if (len(sys.argv) > 1 and sys.argv[1] == "resume") \
+        else None
+    sf = open(STATS, "a", buffering=1)
+    eng = PagedEngine(CFG, CAPS)
+    r = eng.check(on_progress=lambda s: sf.write(json.dumps(s) + "\n"),
+                  checkpoint=CKPT, checkpoint_every_s=300.0,
+                  resume=resume)
+    print(json.dumps({
+        "n_states": r.n_states, "diameter": r.diameter,
+        "n_transitions": r.n_transitions, "complete": r.complete,
+        "violation": r.violation.invariant if r.violation else None,
+        "wall_s": round(r.wall_s, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,12 @@ def check_bounds(bounds, N=4096):
                                         False))
     pal_fn = pallas_orbit.build_orbit_fp(bounds, ("Server",), False,
                                          interpret=False)
+    if pal_fn is None:
+        print(f"{bounds.n_servers}s: pallas kernel declined "
+              f"(P > {pallas_orbit._MAX_COMPILED_PERMS} unrolled perms "
+              "overflows the scoped-vmem stack on real TPUs) — scan "
+              "path serves this shape")
+        return
     js = {k: jnp.asarray(v) for k, v in struct.items()}
     vecs = jnp.asarray(pack_batch(struct, lay))
 
