@@ -5,16 +5,31 @@ The device-resident fingerprint tables cap distinct-state capacity at
 campaign, RESULTS.md "capacity findings").  The DDD engine
 (ddd_engine.py) moves EXACT dedup to the host: candidate keys stream off
 the device, and this module maintains the master set of every discovered
-state's 64-bit fingerprint as a single sorted array, deduplicating
-pending candidates in *first-occurrence stream order* so discovery order
-— and therefore counts, levels, coverage attribution and traces — stays
-byte-identical to the table engines and the pure-Python oracle.
+state's 64-bit fingerprint, deduplicating pending candidates in
+*first-occurrence stream order* so discovery order — and therefore
+counts, levels, coverage attribution and traces — stays byte-identical
+to the table engines and the pure-Python oracle.
 
-Capacity is host RAM: 8 bytes/state (~15B states in this host's 125 GiB),
-three orders of magnitude past the device-table ceiling.  All operations
-are plain NumPy on sorted arrays (this host has one core — a threaded C++
-twin would buy nothing; `np.sort`/`np.searchsorted`/`np.insert` already
-run at memory bandwidth).
+Storage is **tiered sorted runs** (LSM-style), not one monolithic sorted
+array.  The round-2 monolith merged every flush with ``np.insert`` —
+an O(master) rewrite per flush that measurably decayed the elect5
+campaign from 164k to 84k states/s as the master grew 287M → 312M keys
+(runs/elect5ddd.stats; VERDICT r2 weak #1).  Here each flush appends its
+new keys as one new sorted run — O(new) — and runs compact geometrically
+(adjacent runs merge when the older is no more than ``_RATIO``× the
+newer), so each key participates in O(log N) merges and total merge
+*data movement* over N inserted keys is O(N log N) amortized (plus a
+searchsorted log factor on comparisons — memory bandwidth, not
+comparisons, is what the flush decay was made of) and per-flush cost no
+longer scales with the master size.  Lookups
+(`contains`/`dedup` anti-join) searchsort each of the O(log N) runs —
+at 10⁹ keys that is ~30 binary searches per candidate instead of 1,
+still sub-microsecond, while the flush-time rewrite the campaign was
+dying under is gone.
+
+Capacity is host RAM: 8 bytes/state (~15B states in this host's
+125 GiB).  All operations are plain NumPy on sorted arrays; the merge
+primitive is a vectorized O(a+b) two-way merge of disjoint runs.
 
 Replicates TLC's external-memory fingerprint-set regime (the disk-backed
 `states/` dir the reference ignores at `/root/reference/.gitignore:2`),
@@ -23,9 +38,17 @@ host-RAM-resident instead of disk-resident.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 U64 = np.uint64
+
+# Geometric compaction ratio: after appending a run, adjacent runs merge
+# while the older run is <= _RATIO * the newer.  2 gives the classic
+# LSM bound (each key participates in <= log2(N/flush) merges) with at
+# most ~log2(N/flush) live runs.
+_RATIO = 2
 
 
 def pack_keys(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
@@ -34,49 +57,100 @@ def pack_keys(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
     return (hi.astype(U64) << U64(32)) | lo.astype(U64)
 
 
-class MasterKeys:
-    """Sorted master array of discovered-state fingerprints.
+def _merge_disjoint(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Vectorized merge of two sorted arrays with no common keys (runs
+    are mutually disjoint by construction: a new run holds only keys
+    absent from every older run).  O(a+b) data movement + O(b log a)
+    searchsorted comparisons."""
+    if a.size == 0:
+        return b
+    if b.size == 0:
+        return a
+    out = np.empty(a.size + b.size, U64)
+    posb = np.searchsorted(a, b) + np.arange(b.size, dtype=np.int64)
+    amask = np.ones(out.size, bool)
+    amask[posb] = False
+    out[posb] = b
+    out[amask] = a
+    return out
 
-    ``dedup(keys)`` is the only mutating operation: given one flush of
-    candidate keys in stream order, it returns the indices (into that
+
+def _member(run: np.ndarray, keys: np.ndarray) -> np.ndarray:
+    """Boolean membership of ``keys`` in one sorted run."""
+    pos = np.searchsorted(run, keys)
+    inb = pos < run.size
+    hit = np.zeros(keys.shape, bool)
+    hit[inb] = run[pos[inb]] == keys[inb]
+    return hit
+
+
+class MasterKeys:
+    """Tiered sorted runs of discovered-state fingerprints.
+
+    ``dedup(keys)`` is the only bulk-mutating operation: given one flush
+    of candidate keys in stream order, it returns the indices (into that
     flush, ascending) of candidates that are genuinely new — first
-    occurrence within the flush AND absent from the master — and merges
-    exactly those keys in.  Cross-flush first-occurrence order holds
-    because flush i's new keys are in the master before flush i+1 is
-    examined.
+    occurrence within the flush AND absent from every run — and admits
+    exactly those keys as a new run (compacting tiers as needed).
+    Cross-flush first-occurrence order holds because flush i's new keys
+    are in the tiers before flush i+1 is examined.
     """
 
     def __init__(self, keys: np.ndarray | None = None):
-        self._m = np.empty(0, U64) if keys is None \
-            else np.ascontiguousarray(keys, dtype=U64)
-        if self._m.size and np.any(self._m[1:] <= self._m[:-1]):
-            raise ValueError("master keys must be strictly sorted")
+        if keys is None or keys.size == 0:
+            self._runs: list[np.ndarray] = []
+        else:
+            base = np.ascontiguousarray(keys, dtype=U64)
+            if np.any(base[1:] <= base[:-1]):
+                raise ValueError("master keys must be strictly sorted")
+            self._runs = [base]
 
     def __len__(self) -> int:
-        return int(self._m.size)
+        return sum(int(r.size) for r in self._runs)
+
+    @property
+    def n_runs(self) -> int:
+        """Live tier count (diagnostic; O(log N) by construction)."""
+        return len(self._runs)
 
     @property
     def array(self) -> np.ndarray:
-        """The sorted master array (read-only view; for checkpointing)."""
-        v = self._m.view()
+        """The full sorted key set as one array (read-only).  Materializes
+        a merge of all runs — O(N); for tests and inspection, not the
+        hot path."""
+        v = self._runs[0] if len(self._runs) == 1 else \
+            functools.reduce(_merge_disjoint, self._runs, np.empty(0, U64))
+        v = v.view()
         v.flags.writeable = False
         return v
 
     def seed(self, key: int) -> None:
-        """Insert one key (the initial state) into an empty-or-small set."""
-        self._m = np.unique(np.append(self._m, U64(key)))
+        """Insert one key (the initial state) if absent."""
+        self.dedup(np.asarray([key], U64))
 
     def contains(self, keys: np.ndarray) -> np.ndarray:
         keys = keys.astype(U64, copy=False)
-        pos = np.searchsorted(self._m, keys)
-        inb = pos < self._m.size
         hit = np.zeros(keys.shape, bool)
-        hit[inb] = self._m[pos[inb]] == keys[inb]
+        for run in sorted(self._runs, key=lambda r: -r.size):
+            rem = np.flatnonzero(~hit)       # probe only still-unknown
+            if rem.size == 0:                # keys; the largest run
+                break                        # resolves most duplicates
+            hit[rem[_member(run, keys[rem])]] = True
         return hit
 
+    def _append_run(self, run: np.ndarray) -> None:
+        self._runs.append(run)
+        # geometric compaction: merge newest-first while the older
+        # neighbour is small enough that the merge stays amortized
+        while (len(self._runs) >= 2
+               and self._runs[-2].size <= _RATIO * self._runs[-1].size):
+            b = self._runs.pop()
+            a = self._runs.pop()
+            self._runs.append(_merge_disjoint(a, b))
+
     def dedup(self, keys: np.ndarray) -> np.ndarray:
-        """First-occurrence indices of new keys, in stream order; merges
-        the corresponding keys into the master."""
+        """First-occurrence indices of new keys, in stream order; admits
+        the corresponding keys as a new tier."""
         keys = keys.astype(U64, copy=False)
         n = keys.size
         if n == 0:
@@ -86,14 +160,14 @@ class MasterKeys:
         first = np.ones(n, bool)
         first[1:] = sk[1:] != sk[:-1]
         cand_idx = order[first]                   # first occurrence per key
-        cand_keys = sk[first]
-        pos = np.searchsorted(self._m, cand_keys)
-        inb = pos < self._m.size
+        cand_keys = sk[first]                     # sorted, unique
         dup = np.zeros(cand_keys.shape, bool)
-        dup[inb] = self._m[pos[inb]] == cand_keys[inb]
-        new_idx = cand_idx[~dup]
-        if new_idx.size:
-            # np.insert positions refer to the pre-insert array, so one
-            # O(master + new) pass merges the whole sorted batch
-            self._m = np.insert(self._m, pos[~dup], cand_keys[~dup])
-        return np.sort(new_idx)
+        for run in sorted(self._runs, key=lambda r: -r.size):
+            rem = np.flatnonzero(~dup)
+            if rem.size == 0:
+                break
+            dup[rem[_member(run, cand_keys[rem])]] = True
+        new_keys = cand_keys[~dup]                # sorted, disjoint from
+        if new_keys.size:                         # every existing run
+            self._append_run(np.ascontiguousarray(new_keys))
+        return np.sort(cand_idx[~dup])

@@ -178,6 +178,62 @@ def test_masterkeys_unit():
     assert m.array.tolist() == [1, 2, 3, 7, 9, 11]
 
 
+def test_masterkeys_tiers_randomized():
+    """LSM tiers must be observationally identical to a flat set: dedup
+    indices per flush, contains, len, and the materialized array all
+    match a reference dict over many random overlapping flushes."""
+    from raft_tla_tpu.utils.keyset import MasterKeys, _RATIO
+
+    rng = np.random.default_rng(20260731)
+    m = MasterKeys()
+    seen: set[int] = set()
+    for _ in range(40):
+        flush = rng.integers(0, 5000, size=rng.integers(1, 4000),
+                             dtype=np.uint64)
+        # reference first-occurrence semantics
+        want, batch_seen = [], set()
+        for i, k in enumerate(flush.tolist()):
+            if k not in seen and k not in batch_seen:
+                want.append(i)
+                batch_seen.add(k)
+        got = m.dedup(flush)
+        assert got.tolist() == want
+        seen |= batch_seen
+        assert len(m) == len(seen)
+        # geometric tier invariant: every older run > _RATIO x newer
+        runs = m._runs
+        assert all(runs[i].size > _RATIO * runs[i + 1].size
+                   for i in range(len(runs) - 1))
+        # runs stay mutually disjoint and individually sorted
+        for r in runs:
+            assert np.all(r[1:] > r[:-1])
+    probe = np.arange(5000, dtype=np.uint64)
+    assert m.contains(probe).tolist() == [k in seen for k in range(5000)]
+    assert m.array.tolist() == sorted(seen)
+    # tier count stays logarithmic
+    assert m.n_runs <= 16
+
+
+def test_masterkeys_resume_constructor():
+    """The checkpoint-resume path hands a single sorted array; behavior
+    must match a set grown flush-by-flush."""
+    from raft_tla_tpu.utils.keyset import MasterKeys
+
+    base = np.sort(np.unique(
+        np.random.default_rng(7).integers(0, 10**6, 5000, dtype=np.uint64)))
+    m = MasterKeys(base)
+    assert len(m) == base.size and m.n_runs == 1
+    flush = np.concatenate([base[:100], base[:100] + np.uint64(10**7)])
+    new = m.dedup(flush)
+    assert new.tolist() == list(range(100, 200))
+    assert len(m) == base.size + 100
+    bad = base.copy()
+    bad[10] = bad[9]
+    import pytest
+    with pytest.raises(ValueError):
+        MasterKeys(bad)
+
+
 def test_deadline_stops_cleanly():
     """A deadline expiry — including one landing between blocks with an
     empty pipeline — returns complete=False instead of crashing, and the
