@@ -43,7 +43,7 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="Next-disjunct subset (default: full raft.tla:454-465)")
     p.add_argument("--engine", default="device",
                    choices=("device", "paged", "streamed", "ddd", "shard",
-                            "pagedshard", "host", "ref"),
+                            "pagedshard", "ddd-shard", "host", "ref"),
                    help="device: search resident in HBM; paged: HBM ring + "
                         "native host store (capacity bounded by host RAM); "
                         "streamed: host-streamed frontier (no live-window "
@@ -52,7 +52,10 @@ def build_argparser() -> argparse.ArgumentParser:
                         "dedup on the host, no device fingerprint-table "
                         "ceiling (for spaces past ~2^28 distinct states); "
                         "shard: multi-chip mesh; pagedshard: mesh "
-                        "whose per-device stores page to host RAM; host: "
+                        "whose per-device stores page to host RAM; "
+                        "ddd-shard: mesh-sharded DDD — host-exact dedup "
+                        "partitioned over the fingerprint-owner map (the "
+                        "scale engine's multi-chip composition); host: "
                         "per-chunk jit; ref: pure-Python oracle")
     p.add_argument("--max-term", type=int, default=3,
                    help="CONSTRAINT: currentTerm[i] <= N (default 3)")
@@ -105,11 +108,25 @@ def build_argparser() -> argparse.ArgumentParser:
                         "route_peak stat of a dense run; overflow aborts "
                         "loudly; 0 = dense step)")
     p.add_argument("--reshard-to", type=int, default=None, metavar="NDEV",
-                   help="--engine shard only: instead of searching, "
+                   help="shard/ddd/ddd-shard: instead of searching, "
                         "rewrite the --resume checkpoint for an "
                         "NDEV-device mesh, save it to the --checkpoint "
                         "path, print a summary, and exit (a pod-size "
-                        "change no longer discards a run)")
+                        "change no longer discards a run; --engine ddd "
+                        "migrates a single-chip DDD campaign onto a "
+                        "ddd-shard mesh)")
+    p.add_argument("--reshard-cap", type=int, default=None, metavar="N",
+                   help="with --reshard-to (shard engine): grow the "
+                        "destination per-device store to N rows (rescues "
+                        "a run near FAIL_STORE/FAIL_PROBE; default: keep "
+                        "the source capacities)")
+    p.add_argument("--block", type=int, default=None, metavar="ROWS",
+                   help="ddd/ddd-shard: frontier window rows per shard "
+                        "(default: 2^20 for ddd, the smallest chunk-"
+                        "multiple >= 2^18 for ddd-shard; must match the "
+                        "source run when resuming or resharding — the "
+                        "reshard summary prints the value to resume "
+                        "with)")
     p.add_argument("--slices", type=int, default=None,
                    help="multi-slice scale-out for shard/pagedshard: build "
                         "a 2-D (dcn, ici) mesh of N slices x (devices/N) "
@@ -280,6 +297,12 @@ def _simulate(args, config):
 
 
 
+def _ddd_shard_block(chunk: int) -> int:
+    """Smallest chunk-multiple >= 2^18: the default ddd-shard window
+    slice (block needs chunk alignment, not a power of two)."""
+    return chunk * max(1, -(-(1 << 18) // chunk))
+
+
 def _make_cli_mesh(args):
     """1-D mesh, or the 2-D (dcn, ici) slice mesh when --slices is given."""
     import jax
@@ -363,8 +386,29 @@ def _run(args, config):
         if args.route and args.route > seg_rows:
             seg_rows = args.route
         eng = DDDEngine(config, DDDCapacities(
-            block=1 << 20, table=table, seg_rows=seg_rows,
+            block=args.block or 1 << 20, table=table, seg_rows=seg_rows,
             levels=args.levels, route_rows=args.route))
+        return eng.check(on_progress=_stats_cb(args),
+                         checkpoint=args.checkpoint,
+                         checkpoint_every_s=args.checkpoint_every,
+                         resume=args.resume)
+    if args.engine == "ddd-shard":
+        from raft_tla_tpu.models import spec as S
+        from raft_tla_tpu.parallel.ddd_shard_engine import (
+            DDDShardCapacities, DDDShardEngine)
+        mesh = _make_cli_mesh(args)
+        nd = mesh.devices.size
+        # per-shard filter share of the expected state count (traffic
+        # only); per-shard output buffers must hold one chunk's
+        # worst-case post-exchange stream (ndev * chunk * fan-out)
+        A = len(S.action_table(config.bounds, config.spec))
+        table = 1 << max(10, min(26, ((2 * args.cap + nd - 1) // nd - 1)
+                                 .bit_length()))
+        seg_rows = max(1 << 19, 2 * nd * args.chunk * A)
+        blk = args.block or _ddd_shard_block(args.chunk)
+        eng = DDDShardEngine(config, mesh, DDDShardCapacities(
+            block=blk, table=table, seg_rows=seg_rows,
+            levels=args.levels))
         return eng.check(on_progress=_stats_cb(args),
                          checkpoint=args.checkpoint,
                          checkpoint_every_s=args.checkpoint_every,
@@ -413,7 +457,12 @@ def main(argv=None) -> int:
     p = build_argparser()
     args = p.parse_args(argv)
     _DEVICE_ENGINES = ("device", "paged", "streamed", "ddd", "shard",
-                       "pagedshard")
+                       "pagedshard", "ddd-shard")
+    if args.route and args.engine != "ddd":
+        p.error(f"--route requires --engine ddd (got {args.engine}); "
+                "the routed step is not built for other engines — "
+                "dropping it silently would run a different program "
+                "than configured")
     if (args.checkpoint or args.resume) and \
             args.engine not in _DEVICE_ENGINES:
         p.error(f"--checkpoint/--resume require a device-class engine "
@@ -471,29 +520,85 @@ def main(argv=None) -> int:
             return EXIT_ERROR
 
     if args.reshard_to is not None:
-        if args.engine != "shard":
-            print("Error: --reshard-to requires --engine shard",
-                  file=sys.stderr)
+        if args.engine not in ("shard", "ddd", "ddd-shard"):
+            print("Error: --reshard-to requires --engine shard, ddd or "
+                  "ddd-shard", file=sys.stderr)
             return EXIT_ERROR
         if not args.resume or not args.checkpoint:
             print("Error: --reshard-to needs --resume SRC and "
                   "--checkpoint DST", file=sys.stderr)
             return EXIT_ERROR
         _force_cpu(args)
-        from raft_tla_tpu.parallel.shard_engine import (ShardCapacities,
-                                                        reshard_checkpoint)
+        if args.engine == "shard":
+            from raft_tla_tpu.parallel.shard_engine import (
+                ShardCapacities, reshard_checkpoint)
+            caps_src = ShardCapacities(n_states=args.cap,
+                                       levels=args.levels)
+            caps_dst = ShardCapacities(
+                n_states=args.reshard_cap,
+                levels=args.levels) if args.reshard_cap else None
+            try:
+                info = reshard_checkpoint(
+                    config, caps_src, args.resume, args.checkpoint,
+                    args.reshard_to, caps_dst=caps_dst)
+            except Exception as e:
+                print(f"Error: {e}", file=sys.stderr)
+                return EXIT_ERROR
+            print(f"resharded {info['ndev_src']} -> {info['ndev_dst']} "
+                  f"devices: {info['n_states']} states, per-device "
+                  f"{info['per_device']}, window {info['window']} -> "
+                  f"{args.checkpoint}")
+            return EXIT_OK
+        # DDD family: the streams are mesh-independent history; only
+        # window accounting + digest change.  Source geometry is what
+        # this CLI itself would run: single-chip ddd uses block 2^20
+        # with ndev=1; ddd-shard derives its block from --chunk and its
+        # mesh size from --devices.  The destination block preserves the
+        # GLOBAL window size, so every snapshot boundary is shared.
+        from raft_tla_tpu.parallel.ddd_shard_engine import (
+            DDDShardCapacities, reshard_ddd_checkpoint)
+        if args.engine == "ddd":
+            ndev_src, blk_src = 1, args.block or 1 << 20
+        else:
+            if not args.devices:
+                print("Error: ddd-shard reshard needs --devices "
+                      "(the source mesh size)", file=sys.stderr)
+                return EXIT_ERROR
+            ndev_src = args.devices
+            blk_src = args.block or _ddd_shard_block(args.chunk)
+        w_src = ndev_src * blk_src
+        # destination block: prefer preserving the GLOBAL window size
+        # (every snapshot boundary shared), else keep the source block;
+        # either way it must be chunk-aligned or the mesh engine would
+        # reject the digest-pinned block at resume — refuse loudly here
+        # instead of writing an unusable snapshot
+        cand = ([w_src // args.reshard_to]
+                if w_src % args.reshard_to == 0 else []) + [blk_src]
+        blk_dst = next((b for b in cand
+                        if b > 0 and b % args.chunk == 0), None)
+        if blk_dst is None:
+            print(f"Error: neither {cand} rows is a multiple of "
+                  f"--chunk {args.chunk}; no chunk-aligned destination "
+                  "block preserves the source window boundaries — use a "
+                  "chunk that divides the source window (power-of-two "
+                  "chunks always do)", file=sys.stderr)
+            return EXIT_ERROR
         try:
-            info = reshard_checkpoint(
-                config, ShardCapacities(n_states=args.cap,
-                                        levels=args.levels),
-                args.resume, args.checkpoint, args.reshard_to)
+            info = reshard_ddd_checkpoint(
+                config,
+                DDDShardCapacities(block=blk_src, levels=args.levels),
+                args.resume, args.checkpoint, ndev_src, args.reshard_to,
+                caps_dst=DDDShardCapacities(block=blk_dst,
+                                            levels=args.levels))
         except Exception as e:
             print(f"Error: {e}", file=sys.stderr)
             return EXIT_ERROR
-        print(f"resharded {info['ndev_src']} -> {info['ndev_dst']} "
-              f"devices: {info['n_states']} states, per-device "
-              f"{info['per_device']}, window {info['window']} -> "
-              f"{args.checkpoint}")
+        print(f"resharded DDD {info['ndev_src']} -> {info['ndev_dst']} "
+              f"devices: {info['n_states']} states, "
+              f"{info['rows_done']} frontier rows done "
+              f"({info['blocks_done_dst']} windows) -> "
+              f"{args.checkpoint}  [resume with --engine ddd-shard "
+              f"--devices {info['ndev_dst']} --block {blk_dst}]")
         return EXIT_OK
 
     t0 = time.monotonic()

@@ -197,6 +197,58 @@ class _SegCarry(NamedTuple):
     peak: jax.Array
 
 
+def save_ddd_snapshot(path, host, constore, keystore, n_states, n_trans,
+                      cov, level_ends, blocks_done, P, digest) -> None:
+    """ONE definition site for the DDD four-stream snapshot format
+    (.rows/.links/.con/.keys + metadata npz) — the single-chip and
+    mesh-sharded DDD engines interoperate on it byte-for-byte
+    (parallel/ddd_shard_engine.reshard_ddd_checkpoint migrates campaigns
+    between them), so the writer must not fork."""
+    ckpt.stream_rows_append(path + ".rows", host.read, n_states, P)
+
+    def links_reader(start, n):
+        par, lan = host.read_links(start, n)
+        return np.stack([par, lan], axis=1)
+
+    ckpt.stream_rows_append(path + ".links", links_reader, n_states, 2)
+    ckpt.stream_rows_append(path + ".con", constore.read, n_states, 1)
+    ckpt.stream_rows_append(path + ".keys", keystore.read, n_states, 2)
+    ckpt.atomic_savez(
+        path,
+        n_states=np.int64(n_states),
+        n_trans=np.uint64(n_trans),
+        cov=np.asarray(cov, np.int64),
+        level_ends=np.asarray(level_ends, np.int64),
+        blocks_done=np.int64(blocks_done),
+        config_digest=np.uint64(digest))
+
+
+def load_ddd_snapshot(path, P, digest):
+    """Counterpart reader: rebuilds the native stores from the streams
+    (master keys are engine-specific and rebuilt by the caller)."""
+    with ckpt.load_npz_checked(path, digest) as z:
+        n_states = int(z["n_states"])
+        n_trans = int(z["n_trans"])
+        cov = np.asarray(z["cov"], np.int64).copy()
+        level_ends = [int(x) for x in z["level_ends"]]
+        blocks_done = int(z["blocks_done"])
+    host = native.make_store(P)
+    constore = native.make_store(1)
+    keystore = native.make_store(2)
+    ckpt.stream_rows_in(path + ".rows", host.append, n_states,
+                        expect_width=P)
+    ckpt.stream_rows_in(
+        path + ".links",
+        lambda blk: host.append_links(blk[:, 0], blk[:, 1]), n_states,
+        expect_width=2)
+    ckpt.stream_rows_in(path + ".con", constore.append, n_states,
+                        expect_width=1)
+    ckpt.stream_rows_in(path + ".keys", keystore.append, n_states,
+                        expect_width=2)
+    return (host, constore, keystore, n_states, n_trans, cov, level_ends,
+            blocks_done)
+
+
 def _filter_insert(tbl_hi, tbl_lo, key_hi, key_lo, active):
     """Lossy one-gather filter probe + insert.
 
@@ -479,48 +531,17 @@ class DDDEngine:
                         blocks_done: int, init_key) -> None:
         """Block-boundary snapshots with an empty pending buffer; every
         stream (rows/links/constraints/keys) extends incrementally."""
-        ckpt.stream_rows_append(path + ".rows", host.read, n_states,
-                                self.schema.P)
-
-        def links_reader(start, n):
-            par, lan = host.read_links(start, n)
-            return np.stack([par, lan], axis=1)
-
-        ckpt.stream_rows_append(path + ".links", links_reader, n_states, 2)
-        ckpt.stream_rows_append(path + ".con", constore.read, n_states, 1)
-        ckpt.stream_rows_append(path + ".keys", keystore.read, n_states, 2)
-        ckpt.atomic_savez(
-            path,
-            n_states=np.int64(n_states),
-            n_trans=np.uint64(n_trans),
-            cov=np.asarray(cov, np.int64),
-            level_ends=np.asarray(level_ends, np.int64),
-            blocks_done=np.int64(blocks_done),
-            config_digest=np.uint64(
-                ckpt.config_digest(self.config, self._digest_caps, init_key)))
+        save_ddd_snapshot(path, host, constore, keystore, n_states,
+                          n_trans, cov, level_ends, blocks_done,
+                          self.schema.P,
+                          ckpt.config_digest(self.config,
+                                             self._digest_caps, init_key))
 
     def load_checkpoint(self, path: str, init_key):
-        with ckpt.load_npz_checked(
-                path, ckpt.config_digest(self.config, self._digest_caps,
-                                         init_key)) as z:
-            n_states = int(z["n_states"])
-            n_trans = int(z["n_trans"])
-            cov = np.asarray(z["cov"], np.int64).copy()
-            level_ends = [int(x) for x in z["level_ends"]]
-            blocks_done = int(z["blocks_done"])
-        host = native.make_store(self.schema.P)
-        constore = native.make_store(1)
-        keystore = native.make_store(2)
-        ckpt.stream_rows_in(path + ".rows", host.append, n_states,
-                            expect_width=self.schema.P)
-        ckpt.stream_rows_in(
-            path + ".links",
-            lambda blk: host.append_links(blk[:, 0], blk[:, 1]), n_states,
-            expect_width=2)
-        ckpt.stream_rows_in(path + ".con", constore.append, n_states,
-                            expect_width=1)
-        ckpt.stream_rows_in(path + ".keys", keystore.append, n_states,
-                            expect_width=2)
+        (host, constore, keystore, n_states, n_trans, cov, level_ends,
+         blocks_done) = load_ddd_snapshot(
+            path, self.schema.P,
+            ckpt.config_digest(self.config, self._digest_caps, init_key))
         kw = keystore.read(0, n_states).view(np.uint32)
         keys = keyset.pack_keys(kw[:, 1], kw[:, 0])
         master = keyset.MasterKeys(np.sort(keys))

@@ -6,6 +6,9 @@ from raft_tla_tpu.parallel.shard_engine import (  # noqa: F401
 # and the CP expansion load lazily — importing the package stays as
 # cheap as the repo's lazy-import layering everywhere else assumes.
 _LAZY = {
+    "DDDShardCapacities": "ddd_shard_engine",
+    "DDDShardEngine": "ddd_shard_engine",
+    "reshard_ddd_checkpoint": "ddd_shard_engine",
     "PagedShardCapacities": "paged_shard_engine",
     "PagedShardEngine": "paged_shard_engine",
     "build_cp_expand": "cp_expand",

@@ -165,6 +165,26 @@ def trim_stream(path: str, n_rows: int, width: int) -> None:
         os.fsync(f.fileno())
 
 
+def copy_stream(src: str, dst: str, n_rows: int, width: int) -> None:
+    """Copy the first ``n_rows`` of an append-only stream to a new path
+    (atomic; blockwise — used by checkpoint resharders, where the stream
+    is mesh-independent history and moves verbatim)."""
+    with open(src, "rb") as f:
+        have, w = (int(x) for x in np.fromfile(f, np.int64, 2))
+        if w != width:
+            raise ValueError(
+                f"stream {src} has row width {w}, expected {width}")
+        if have < n_rows:
+            raise ValueError(
+                f"stream {src} holds {have} rows, need {n_rows}")
+
+        def reader(start, n):
+            f.seek(16 + start * width * 4)
+            return np.fromfile(f, np.int32, n * width).reshape(n, width)
+
+        stream_rows_out(dst, reader, n_rows, width)
+
+
 def stream_rows_in(path: str, writer, limit: int,
                    expect_width: int | None = None) -> int:
     """Feed the first ``limit`` rows of ``path`` through ``writer(block)``.

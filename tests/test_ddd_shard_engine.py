@@ -1,0 +1,246 @@
+"""Mesh-sharded DDD engine (parallel/ddd_shard_engine.py).
+
+The scale architecture's multi-chip composition: host-exact dedup
+partitioned over the mesh's fingerprint-owner map, canonical
+(level, window, shard) discovery order.  Gates: oracle-exact totals on
+the 8-device virtual CPU mesh, ndev-invariance, IDENTITY with the
+single-chip DDD engine on a 1-device mesh (order and checkpoint
+included), parity under forced filter eviction, valid replayable
+violation/deadlock counterexamples, window-boundary checkpoint/resume,
+and checkpoint resharding across mesh sizes (including adopting a
+single-chip campaign checkpoint onto a mesh).
+"""
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.models import interp, refbfs, spec as S
+from raft_tla_tpu.ops import msgbits as mb
+from raft_tla_tpu.parallel.ddd_shard_engine import (
+    DDDShardCapacities, DDDShardEngine, reshard_ddd_checkpoint)
+from raft_tla_tpu.parallel.shard_engine import make_mesh, make_slice_mesh
+
+CFG = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                max_log=0, max_msgs=2),
+                  spec="election", invariants=("NoTwoLeaders",), chunk=32)
+CAPS = DDDShardCapacities(block=256, table=1 << 14, seg_rows=1 << 14,
+                          flush=1 << 10, levels=64)
+
+
+def assert_totals(got, ref):
+    assert got.n_states == ref.n_states
+    assert got.diameter == ref.diameter
+    assert got.levels == ref.levels
+    assert got.n_transitions == ref.n_transitions
+    assert sum(got.coverage.values()) == sum(ref.coverage.values())
+
+
+def test_election_2server_parity_8dev():
+    ref = refbfs.check(CFG)
+    got = DDDShardEngine(CFG, make_mesh(8), CAPS).check()
+    assert_totals(got, ref)
+    assert got.n_states == 3014 and got.diameter == 17
+    assert got.violation is None
+
+
+def test_single_dev_mesh_equals_single_chip():
+    """ndev=1: canonical order degenerates to the single-chip DDD
+    engine's stream order — coverage attribution (order-dependent)
+    must match refbfs exactly, not just in total."""
+    ref = refbfs.check(CFG)
+    got = DDDShardEngine(CFG, make_mesh(1), CAPS).check()
+    assert_totals(got, ref)
+    assert got.coverage == ref.coverage
+
+
+def test_ndev_invariance():
+    runs = {n: DDDShardEngine(CFG, make_mesh(n), CAPS).check()
+            for n in (1, 2, 8)}
+    base = runs[1]
+    for n, r in runs.items():
+        assert r.n_states == base.n_states, n
+        assert r.levels == base.levels, n
+        assert r.n_transitions == base.n_transitions, n
+
+
+def test_multi_segment_windows_8dev():
+    """Windows needing several device dispatches (tiny segment budget +
+    near-full output buffers) must work: the first continuation call
+    passes a committed-sharding chunk cursor, which retraces the pjit —
+    a build-time-closure leak crashed exactly here (review regression).
+    seg_rows is just past the one-chunk receivable bound, so buffer-full
+    halts fire too."""
+    import math
+
+    ref = refbfs.check(CFG)
+    nr = 8 * CFG.chunk * 11          # ndev * chunk * A upper bound
+    caps = DDDShardCapacities(block=256, table=1 << 14,
+                              seg_rows=1 << max(12, math.ceil(
+                                  math.log2(nr + 1))),
+                              flush=1 << 10, levels=64)
+    eng = DDDShardEngine(CFG, make_mesh(8), caps, seg_chunks=4)
+    got = eng.check()
+    assert_totals(got, ref)
+
+
+def test_parity_under_forced_eviction_8dev():
+    """A 128-slot per-shard filter evicts constantly on a 3014-state
+    space; the sharded host dedup must absorb every re-sight."""
+    ref = refbfs.check(CFG)
+    caps = DDDShardCapacities(block=256, table=1 << 7, seg_rows=1 << 14,
+                              flush=1 << 9, levels=64)
+    got = DDDShardEngine(CFG, make_mesh(8), caps).check()
+    assert_totals(got, ref)
+
+
+def test_slice_mesh_2x4_parity():
+    ref = refbfs.check(CFG)
+    got = DDDShardEngine(CFG, make_slice_mesh(2, 4), CAPS).check()
+    assert_totals(got, ref)
+
+
+def test_symmetry_composes_8dev():
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      symmetry=("Server",), chunk=32)
+    ref = refbfs.check(cfg)
+    got = DDDShardEngine(cfg, make_mesh(8), CAPS).check()
+    assert_totals(got, ref)
+    assert got.n_states == 1514
+
+
+def test_violation_trace_replayable_8dev():
+    """Seeded NaiveNoTwoLeaders violation: the counterexample may be a
+    different one than refbfs's (chunk-granular relaxed stop, as
+    shard_engine), but must start at Init, follow real transitions, and
+    violate the same invariant."""
+    from raft_tla_tpu.models import invariants as inv_mod
+
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=4, max_dup=1)
+    cfg = CheckConfig(bounds=bounds, spec="election",
+                      invariants=("NaiveNoTwoLeaders",), chunk=64)
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3),
+        votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100),
+        msgs=tuple(sorted((m, 1) for m in
+                          (mb.rv_response(3, 1, 1, 2),))),
+    )
+    caps = DDDShardCapacities(block=1 << 12, table=1 << 14,
+                              seg_rows=1 << 15, flush=1 << 12, levels=64)
+    got = DDDShardEngine(cfg, make_mesh(8), caps).check(
+        init_override=start)
+    assert got.violation is not None
+    assert got.violation.invariant == "NaiveNoTwoLeaders"
+    trace = got.violation.trace
+    assert trace[0][0] is None and trace[0][1] == start
+    for (_l, prev), (_label, cur) in zip(trace, trace[1:]):
+        succs = [t for _i, t in interp.successors(prev, bounds,
+                                                  spec="election")]
+        assert cur in succs
+    assert not inv_mod.py_invariant("NaiveNoTwoLeaders")(
+        got.violation.state, bounds)
+
+
+def test_deadlock_detected_8dev():
+    cfg = CheckConfig(bounds=Bounds(n_servers=1, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=2),
+                      spec="election", invariants=(), chunk=16,
+                      check_deadlock=True)
+    ref = refbfs.check(cfg)
+    caps = DDDShardCapacities(block=64, table=1 << 7, seg_rows=1 << 12,
+                              flush=1 << 8, levels=64)
+    got = DDDShardEngine(cfg, make_mesh(8), caps).check()
+    assert ref.violation is not None and got.violation is not None
+    assert got.violation.invariant == ref.violation.invariant  # DEADLOCK
+    # the dead state must genuinely have no successors
+    dead = got.violation.state
+    assert not list(interp.successors(dead, cfg.bounds, spec="election"))
+
+
+def test_routing_overflow_is_loud():
+    caps = DDDShardCapacities(block=256, table=1 << 14, seg_rows=1 << 14,
+                              flush=1 << 10, levels=64, send=1)
+    with pytest.raises(RuntimeError, match="routing budget"):
+        DDDShardEngine(CFG, make_mesh(8), caps).check()
+
+
+def test_checkpoint_resume_exact_8dev(tmp_path):
+    ck = str(tmp_path / "dddsh.ckpt")
+    mesh = make_mesh(8)
+    straight = DDDShardEngine(CFG, mesh, CAPS).check()
+    res = DDDShardEngine(CFG, mesh, CAPS).check(checkpoint=ck,
+                                                checkpoint_every_s=0.0)
+    assert res.n_states == straight.n_states
+    resumed = DDDShardEngine(CFG, mesh, CAPS).check(resume=ck)
+    assert resumed.n_states == straight.n_states
+    assert resumed.levels == straight.levels
+    assert resumed.n_transitions == straight.n_transitions
+    assert resumed.coverage == res.coverage   # identical canonical order
+    assert resumed.violation is None
+
+    # a different mesh size must refuse the snapshot (owner map changed)
+    with pytest.raises(ValueError, match="digest|different model"):
+        DDDShardEngine(CFG, make_mesh(4), CAPS).check(resume=ck)
+
+
+def test_reshard_across_mesh_sizes(tmp_path):
+    """8 -> 2 devices with equal global window size (block scaled 4x):
+    every window boundary is shared, the streams move verbatim, and the
+    resumed run completes with oracle-exact totals."""
+    ck8 = str(tmp_path / "m8.ckpt")
+    ck2 = str(tmp_path / "m2.ckpt")
+    DDDShardEngine(CFG, make_mesh(8), CAPS).check(
+        checkpoint=ck8, checkpoint_every_s=0.0)
+    caps2 = DDDShardCapacities(block=1024, table=1 << 14,
+                               seg_rows=1 << 14, flush=1 << 10, levels=64)
+    info = reshard_ddd_checkpoint(CFG, CAPS, ck8, ck2, ndev_src=8,
+                                  ndev_dst=2, caps_dst=caps2)
+    assert info["ndev_dst"] == 2
+    ref = refbfs.check(CFG)
+    got = DDDShardEngine(CFG, make_mesh(2), caps2).check(resume=ck2)
+    assert_totals(got, ref)
+
+
+def test_adopt_single_chip_checkpoint(tmp_path):
+    """A single-chip DDD campaign checkpoint migrates onto the mesh:
+    ndev_src=1 with the single-chip block inside caps_src (the stream
+    formats are identical by design)."""
+    from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+
+    ck1 = str(tmp_path / "chip.ckpt")
+    ckm = str(tmp_path / "mesh.ckpt")
+    sc_caps = DDDCapacities(block=1024, table=1 << 14, flush=1 << 10,
+                            levels=64)
+    DDDEngine(CFG, sc_caps).check(checkpoint=ck1, checkpoint_every_s=0.0)
+    caps_src = DDDShardCapacities(block=1024, table=1 << 14,
+                                  seg_rows=1 << 14, flush=1 << 10,
+                                  levels=64)
+    caps_dst = DDDShardCapacities(block=256, table=1 << 14,
+                                  seg_rows=1 << 14, flush=1 << 10,
+                                  levels=64)
+    reshard_ddd_checkpoint(CFG, caps_src, ck1, ckm, ndev_src=1,
+                           ndev_dst=4, caps_dst=caps_dst)
+    ref = refbfs.check(CFG)
+    got = DDDShardEngine(CFG, make_mesh(4), caps_dst).check(resume=ckm)
+    assert_totals(got, ref)
+
+
+def test_full_spec_small_parity_8dev():
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=1, max_msgs=2),
+                      spec="full",
+                      invariants=("NoTwoLeaders", "LogMatching",
+                                  "CommittedWithinLog"),
+                      chunk=128)
+    ref = refbfs.check(cfg)
+    caps = DDDShardCapacities(block=1 << 12, table=1 << 14,
+                              seg_rows=1 << 15, flush=1 << 12, levels=64)
+    got = DDDShardEngine(cfg, make_mesh(8), caps).check()
+    assert_totals(got, ref)
+    for fam in (S.RESTART, S.DUPLICATE, S.DROP):
+        assert got.coverage[fam] > 0
