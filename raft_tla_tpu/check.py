@@ -127,6 +127,12 @@ def build_argparser() -> argparse.ArgumentParser:
                         "source run when resuming or resharding — the "
                         "reshard summary prints the value to resume "
                         "with)")
+    p.add_argument("--view", default=None, choices=("deadvotes",),
+                   help="TLC VIEW analog: fold a registered EXACT view "
+                        "into every dedup key (models/views.py carries "
+                        "the soundness argument; deadvotes: zero "
+                        "votesResponded/votesGranted of non-Candidates — "
+                        "collapses dead vote-set freight, same verdicts)")
     p.add_argument("--slices", type=int, default=None,
                    help="multi-slice scale-out for shard/pagedshard: build "
                         "a 2-D (dcn, ici) mesh of N slices x (devices/N) "
@@ -258,7 +264,8 @@ def _resolve_config(args):
     return CheckConfig(bounds=bounds, spec=args.spec,
                        invariants=tuple(cfg.invariants), symmetry=symmetry,
                        chunk=args.chunk,
-                       check_deadlock=args.deadlock), tuple(props)
+                       check_deadlock=args.deadlock,
+                       view=args.view), tuple(props)
 
 
 def _stats_cb(args):
@@ -458,6 +465,16 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     _DEVICE_ENGINES = ("device", "paged", "streamed", "ddd", "shard",
                        "pagedshard", "ddd-shard")
+    if args.view and (args.property or args.simulate):
+        p.error("--view composes with the exhaustive safety engines "
+                "only; liveness graphs and simulation replay key states "
+                "unviewed — run those without --view")
+    if args.reshard_cap and not (args.reshard_to and
+                                 args.engine == "shard"):
+        p.error("--reshard-cap only applies to --reshard-to with "
+                "--engine shard (the DDD snapshots carry no per-device "
+                "store capacity); dropping it silently would ignore "
+                "the configured rescue")
     if args.route and args.engine != "ddd":
         p.error(f"--route requires --engine ddd (got {args.engine}); "
                 "the routed step is not built for other engines — "
@@ -491,6 +508,13 @@ def main(argv=None) -> int:
     if config.symmetry:
         print(f"Symmetry: {' x '.join(config.symmetry)} permutations "
               "(counting orbits)")
+    if config.view:
+        if props:
+            print(f"Error: PROPERTY {list(props)} cannot be checked "
+                  "under --view (liveness graphs key states unviewed)",
+                  file=sys.stderr)
+            return EXIT_ERROR
+        print(f"View: {config.view} (counting view-quotient states)")
 
     if args.emit_tlc:
         from raft_tla_tpu.models import tla_export
@@ -498,7 +522,8 @@ def main(argv=None) -> int:
             tla, cfgp = tla_export.export(args.emit_tlc, b,
                                           config.invariants,
                                           parity_view=not b.history,
-                                          symmetry=config.symmetry)
+                                          symmetry=config.symmetry,
+                                          view=config.view)
         except (OSError, ValueError) as e:
             print(f"Error: {e}", file=sys.stderr)
             return EXIT_ERROR

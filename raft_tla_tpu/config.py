@@ -139,6 +139,9 @@ class CheckConfig:
     symmetry: tuple = ()                   # () or ("Server",): TLC SYMMETRY
     chunk: int = 1024                      # frontier states expanded per jit call
     check_deadlock: bool = False           # TLC -deadlock analog (off: Restart is always enabled anyway)
+    view: str | None = None                # TLC VIEW analog: a registered
+    #   exact view (models/views.py) folded into every dedup key; None =
+    #   identity.  Joins the checkpoint digest when set.
 
     def __post_init__(self) -> None:
         if not self.bounds.history:
@@ -148,3 +151,9 @@ class CheckConfig:
                 raise ValueError(
                     f"invariant(s) {hist} read the history variables; they "
                     "require faithful mode (Bounds.history / --faithful)")
+        if self.view is not None:
+            from raft_tla_tpu.models.views import REGISTRY
+            if self.view not in REGISTRY:
+                raise ValueError(
+                    f"unknown view {self.view!r} "
+                    f"(known: {sorted(REGISTRY)})")

@@ -307,10 +307,11 @@ def _build_segment(config: CheckConfig, caps: DDDCapacities, A: int,
     if routed:
         step = kernels.build_step_routed(
             config.bounds, config.spec, tuple(config.invariants),
-            config.symmetry, k_rows=caps.route_rows)
+            config.symmetry, k_rows=caps.route_rows, view=config.view)
     else:
         step = kernels.build_step(config.bounds, config.spec,
-                                  tuple(config.invariants), config.symmetry)
+                                  tuple(config.invariants), config.symmetry,
+                                  view=config.view)
     BIG = jnp.int32(np.iinfo(np.int32).max)
 
     def chunk_body(carry: _SegCarry) -> _SegCarry:

@@ -307,7 +307,8 @@ def _build_segment(config: CheckConfig, caps: Capacities, A: int, W: int):
     B = config.chunk
     n_inv = len(config.invariants)
     step = kernels.build_step(config.bounds, config.spec,
-                              tuple(config.invariants), config.symmetry)
+                              tuple(config.invariants), config.symmetry,
+                              view=config.view)
     Ncap, Lcap, Tcap = caps.n_states, caps.levels, caps.table
     BIG = jnp.int32(np.iinfo(np.int32).max)
 

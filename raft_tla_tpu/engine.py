@@ -124,7 +124,7 @@ class Engine:
         self.chunk = config.chunk
         self._step = jax.jit(kernels.build_step(
             self.bounds, config.spec, tuple(config.invariants),
-            config.symmetry))
+            config.symmetry, view=config.view))
 
     # -- public API ----------------------------------------------------------
 

@@ -51,10 +51,16 @@ def check(config: CheckConfig, max_states: int | None = None,
     bounds = config.bounds
     table = S.action_table(bounds, config.spec)
     invs = [(nm, invariants.py_invariant(nm)) for nm in config.invariants]
+    viewf = None
+    if getattr(config, "view", None):
+        from raft_tla_tpu.models import views
+        viewf = views.py_view(config.view)
     if config.symmetry:
         from raft_tla_tpu.ops import symmetry as sym_mod
         keyf = lambda s: sym_mod.py_orbit_fingerprint(  # noqa: E731
-            s, bounds, config.symmetry)
+            viewf(s, bounds) if viewf else s, bounds, config.symmetry)
+    elif viewf:
+        keyf = lambda s: viewf(s, bounds)                         # noqa: E731
     else:
         keyf = lambda s: s                                        # noqa: E731
     t0 = time.monotonic()

@@ -136,9 +136,20 @@ ParityView ==
        votesResponded, votesGranted, nextIndex, matchIndex >>"""
 
 
+_DEAD_VOTES = """\
+\\* The deadvotes VIEW (models/views.py): vote sets of non-Candidates are
+\\* dead variables — every read in raft.tla (RequestVote raft.tla:196-203,
+\\* BecomeLeader raft.tla:236-238, HandleRequestVoteResponse
+\\* raft.tla:341-350) is Candidate-guarded, and Timeout (raft.tla:180-187)
+\\* resets them — so masking them is an exact quotient.
+DeadVotes(v) == [i \\in Server |-> IF state[i] = Candidate THEN v[i]
+                                   ELSE {}]"""
+
+
 def emit_module(bounds: Bounds, invariants: tuple,
-                parity_view: bool = True, symmetry: bool = False) -> str:
-    """The ``MCraft.tla`` text: invariants + StateConstraint (+ ParityView)."""
+                parity_view: bool = True, symmetry: bool = False,
+                view: str | None = None) -> str:
+    """The ``MCraft.tla`` text: invariants + StateConstraint (+ VIEW)."""
     unknown = [nm for nm in invariants if nm not in _INVARIANT_TLA]
     if unknown:
         raise ValueError(f"no TLA+ export for invariants: {unknown}")
@@ -157,8 +168,25 @@ StateConstraint ==
     /\\ \\A i \\in Server : Len(log[i]) <= {bounds.max_log}
     /\\ Cardinality(DOMAIN messages) <= {bounds.max_msgs}
     /\\ \\A m \\in DOMAIN messages : messages[m] <= {bounds.max_dup}""", ""]
+    if view not in (None, "deadvotes"):
+        raise ValueError(f"no TLA+ export for view {view!r}")
+    if view:
+        parts += [_DEAD_VOTES, ""]
     if parity_view:
-        parts += [_PARITY_VIEW, ""]
+        pv = _PARITY_VIEW
+        if view:
+            pv = pv.replace(
+                "votesResponded, votesGranted",
+                "DeadVotes(votesResponded), DeadVotes(votesGranted)")
+        parts += [pv, ""]
+    elif view:
+        # faithful mode: identity keeps the history variables, only the
+        # dead vote sets are masked
+        parts += ["""\
+DeadVotesView ==
+    << messages, currentTerm, state, votedFor, log, commitIndex,
+       DeadVotes(votesResponded), DeadVotes(votesGranted),
+       nextIndex, matchIndex, elections, allLogs, voterLog >>""", ""]
     if symmetry:
         union = " \\cup ".join(f"Permutations({ax})"
                                for ax in _sym_axes(symmetry))
@@ -172,7 +200,8 @@ StateConstraint ==
 
 
 def emit_cfg(bounds: Bounds, invariants: tuple,
-             parity_view: bool = True, symmetry: bool = False) -> str:
+             parity_view: bool = True, symmetry: bool = False,
+             view: str | None = None) -> str:
     """The ``MCraft.cfg`` text: reference bindings + the new stanzas."""
     servers = ", ".join(f"s{i + 1}" for i in range(bounds.n_servers))
     values = ", ".join(f"v{i + 1}" for i in range(bounds.n_values))
@@ -181,7 +210,8 @@ def emit_cfg(bounds: Bounds, invariants: tuple,
         "",
         *[f"INVARIANT {nm}" for nm in invariants],
         "CONSTRAINT StateConstraint",
-        *(["VIEW ParityView"] if parity_view else []),
+        *(["VIEW ParityView"] if parity_view
+          else ["VIEW DeadVotesView"] if view else []),
         *([f"SYMMETRY {_sym_name(symmetry)}"] if symmetry else []),
         "",
         "CONSTANTS",
@@ -201,7 +231,8 @@ def emit_cfg(bounds: Bounds, invariants: tuple,
 
 
 def export(outdir: str, bounds: Bounds, invariants: tuple,
-           parity_view: bool = True, symmetry: bool = False) -> tuple:
+           parity_view: bool = True, symmetry: bool = False,
+           view: str | None = None) -> tuple:
     """Write ``MCraft.tla``/``MCraft.cfg`` into ``outdir``; return the paths.
 
     Run on a host with a JVM as::
@@ -214,7 +245,8 @@ def export(outdir: str, bounds: Bounds, invariants: tuple,
     tla = os.path.join(outdir, f"{MODULE_NAME}.tla")
     cfg = os.path.join(outdir, f"{MODULE_NAME}.cfg")
     with open(tla, "w", encoding="utf-8") as f:
-        f.write(emit_module(bounds, invariants, parity_view, symmetry))
+        f.write(emit_module(bounds, invariants, parity_view, symmetry,
+                            view))
     with open(cfg, "w", encoding="utf-8") as f:
-        f.write(emit_cfg(bounds, invariants, parity_view, symmetry))
+        f.write(emit_cfg(bounds, invariants, parity_view, symmetry, view))
     return tla, cfg

@@ -1,0 +1,88 @@
+"""Built-in state views — the TLC ``VIEW`` mechanism, engine-native.
+
+TLC's VIEW semantics (cfg ``VIEW <op>``): two states are identified
+whenever their view values coincide; the first-reached full state serves
+as the orbit representative for successor generation, and invariants are
+evaluated on full states.  A view is EXACT (never misses a violation,
+never reports a spurious one) when view-equivalence is a bisimulation
+with respect to every action and the checked invariants read only
+view-preserved fields.  This module registers such views; arbitrary
+user expressions (which TLC accepts unsoundly — the manual pushes the
+proof obligation onto the user) are intentionally not supported.
+
+``deadvotes`` — zero ``votesResponded[i]``/``votesGranted[i]`` whenever
+``state[i] /= Candidate``.  Soundness argument (the bisimulation is
+checked mechanically by tests/test_views.py::test_deadvotes_bisimulation
+against THIS implementation's action semantics):
+
+- every READ of the vote sets in the spec is guarded by
+  ``state[i] = Candidate``: the ``RequestVote`` enabling condition
+  (raft.tla:196-203 — ``j # votesResponded[i]`` under Candidate), the
+  ``BecomeLeader`` quorum guard (raft.tla:236-238), and the
+  ``HandleRequestVoteResponse`` accumulation (raft.tla:341-350, reached
+  only for messages at ``currentTerm[i]`` — a Candidate-term exchange);
+- every other action either leaves the sets untouched or RESETS them
+  (``Timeout``, raft.tla:180-187) independently of their old value;
+- therefore two states differing only in a non-Candidate server's vote
+  sets enable identical actions and their successors differ only the
+  same way: view-equivalence is a bisimulation, and the quotient search
+  is exact for every property that does not read dead vote sets — no
+  registered invariant (models/invariants.py) reads them at all.
+
+Why it matters: the elect5 campaign's coverage telemetry showed 244.7M
+of 311.6M discoveries credited to RequestVote — vote-set combinatorics
+of concurrent candidacies dominate the space, and every candidacy that
+loses (server overtaken by a higher term) strands its half-accumulated
+vote sets as dead freight that multiplies states (VERDICT r2 weak #7).
+
+Views compose with SYMMETRY: the view map is permutation-equivariant
+(roles permute together with vote sets), so ``orbit_fp(view(s))`` is
+well-defined and the quotient orders commute.
+"""
+
+from __future__ import annotations
+
+from raft_tla_tpu.config import Bounds
+
+# view name -> short description (CLI help, cfg validation)
+REGISTRY = {
+    "deadvotes": "zero votesResponded/votesGranted of non-Candidates "
+                 "(exact: dead-variable elimination)",
+}
+
+
+def py_view(name: str):
+    """Host-side view map: PyState -> PyState (the oracle twin)."""
+    if name == "deadvotes":
+        from raft_tla_tpu.models import spec as S
+
+        def view(s, bounds: Bounds):
+            vr = tuple(v if r == S.CANDIDATE else 0
+                       for v, r in zip(s.vResp, s.role))
+            vg = tuple(v if r == S.CANDIDATE else 0
+                       for v, r in zip(s.vGrant, s.role))
+            if vr == s.vResp and vg == s.vGrant:
+                return s
+            return s._replace(vResp=vr, vGrant=vg)
+
+        return view
+    raise ValueError(f"unknown view {name!r} (known: {sorted(REGISTRY)})")
+
+
+def jnp_view(name: str, bounds: Bounds):
+    """Device-side view map on an unpacked state struct (ops/state.py
+    layout) — must be arithmetic-identical to :func:`py_view`."""
+    if name == "deadvotes":
+        import jax.numpy as jnp
+
+        from raft_tla_tpu.models import spec as S
+
+        def view(struct):
+            cand = struct["role"] == S.CANDIDATE
+            out = dict(struct)
+            out["vResp"] = jnp.where(cand, struct["vResp"], 0)
+            out["vGrant"] = jnp.where(cand, struct["vGrant"], 0)
+            return out
+
+        return view
+    raise ValueError(f"unknown view {name!r} (known: {sorted(REGISTRY)})")

@@ -531,13 +531,13 @@ def _alllogs_update(bounds, s, n_lanes):
 
 
 def _step_stages(bounds: Bounds, spec: str, invariants: tuple,
-                 symmetry: tuple):
+                 symmetry: tuple, view: str | None = None):
     """The shared builder prologue of the dense and EP-routed steps:
     layout, fingerprint constants, the expansion, the invariant
-    predicates, and the orbit-fingerprint pipeline.  One definition site
-    so the two steps can never disagree on key arithmetic (the parity
-    and checkpoint-compatibility guarantees rest on bit-identical
-    fingerprints)."""
+    predicates, the orbit-fingerprint pipeline, and the dedup-key view.
+    One definition site so the step variants can never disagree on key
+    arithmetic (the parity and checkpoint-compatibility guarantees rest
+    on bit-identical fingerprints)."""
     from raft_tla_tpu.models import invariants as inv_mod
     from raft_tla_tpu.ops import symmetry as sym
 
@@ -555,17 +555,24 @@ def _step_stages(bounds: Bounds, spec: str, invariants: tuple,
     # each candidate once instead of once per group element.  Opt-in via
     # RAFT_TLA_PALLAS_ORBIT=1 (bit-identical keys — tests/
     # test_pallas_orbit.py — so checkpoints carry across the switch);
-    # covers Server-only parity mode, else falls back to the scan path.
+    # covers Server-only parity mode without a view, else the scan path.
     pallas_orbit_fp = None
-    if symmetry and os.environ.get("RAFT_TLA_PALLAS_ORBIT", "0") == "1":
+    if symmetry and not view \
+            and os.environ.get("RAFT_TLA_PALLAS_ORBIT", "0") == "1":
         from raft_tla_tpu.ops import pallas_orbit
         pallas_orbit_fp = pallas_orbit.build_orbit_fp(
             bounds, symmetry, "allLogs" in lay.shapes)
-    return lay, consts, expand, inv_fns, orbit_fp, pallas_orbit_fp
+    # The view folds into the DEDUP KEY only: stored rows, invariants and
+    # the constraint all see the full successor (TLC VIEW semantics).
+    viewer = None
+    if view:
+        from raft_tla_tpu.models import views as views_mod
+        viewer = views_mod.jnp_view(view, bounds)
+    return lay, consts, expand, inv_fns, orbit_fp, pallas_orbit_fp, viewer
 
 
 def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
-               symmetry: tuple = ()):
+               symmetry: tuple = (), view: str | None = None):
     """One fused frontier step: packed vecs -> everything the engine needs.
 
     ``step(vecs[B, W]) -> dict`` with packed successors ``svecs [B, A, W]``,
@@ -580,7 +587,7 @@ def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
     (ops/symmetry.py) — the dedup key that quotients the state space the
     way TLC's SYMMETRY stanza does.
     """
-    stages = _step_stages(bounds, spec, invariants, symmetry)
+    stages = _step_stages(bounds, spec, invariants, symmetry, view)
     lay = stages[0]
     expand = stages[2]
 
@@ -601,21 +608,28 @@ def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
 
 def apply_stages(bounds, stages, symmetry, succs, svecs, valid):
     """The per-candidate stage block on ``[B, A]``-shaped successors —
-    orbit/plain fingerprints, invariants, StateConstraint.  One
+    view, orbit/plain fingerprints, invariants, StateConstraint.  One
     definition shared by the dense step and the CP-sharded step (the
     EP-routed step runs the same stages on its compacted ``[K]`` axis)."""
-    lay, consts, _expand, inv_fns, orbit_fp, pallas_orbit_fp = stages
+    lay, consts, _expand, inv_fns, orbit_fp, pallas_orbit_fp, viewer = \
+        stages
+    ksuccs, ksvecs = succs, svecs          # dedup-key inputs
+    if viewer is not None:
+        ksuccs = jax.vmap(jax.vmap(viewer))(succs)
+        if not symmetry:
+            ksvecs = jax.vmap(jax.vmap(
+                lambda t: st.pack(t, jnp)))(ksuccs)
     if symmetry:
         if pallas_orbit_fp is not None:
-            fh, fl = pallas_orbit_fp(svecs.reshape(-1, lay.width))
+            fh, fl = pallas_orbit_fp(ksvecs.reshape(-1, lay.width))
         else:
             flat = jax.tree.map(
-                lambda a: a.reshape((-1,) + a.shape[2:]), succs)
+                lambda a: a.reshape((-1,) + a.shape[2:]), ksuccs)
             fh, fl = orbit_fp(flat)
         fp_hi = fh.reshape(svecs.shape[:2])
         fp_lo = fl.reshape(svecs.shape[:2])
     else:
-        fp_hi, fp_lo = fpr.fingerprint(svecs, consts, jnp)
+        fp_hi, fp_lo = fpr.fingerprint(ksvecs, consts, jnp)
     if inv_fns:
         inv_ok = jnp.stack(
             [jax.vmap(jax.vmap(f))(succs) for f in inv_fns], axis=-1)
@@ -628,7 +642,7 @@ def apply_stages(bounds, stages, symmetry, succs, svecs, valid):
 
 def build_step_routed(bounds: Bounds, spec: str = "full",
                       invariants: tuple = (), symmetry: tuple = (),
-                      k_rows: int = 0):
+                      k_rows: int = 0, view: str | None = None):
     """EP-style routed frontier step (SURVEY §2.9, EP row): compact the
     enabled lanes, then run the expensive per-candidate stages densely.
 
@@ -668,8 +682,8 @@ def build_step_routed(bounds: Bounds, spec: str = "full",
     default.  Correct for parity AND faithful mode (the expansion twin
     carries the allLogs update; history fields ride the same gather).
     """
-    (lay, consts, expand, inv_fns, orbit_fp,
-     pallas_orbit_fp) = _step_stages(bounds, spec, invariants, symmetry)
+    (lay, consts, expand, inv_fns, orbit_fp, pallas_orbit_fp,
+     viewer) = _step_stages(bounds, spec, invariants, symmetry, view)
     if k_rows <= 0:
         raise ValueError(f"k_rows={k_rows} must be positive")
     K = int(k_rows)
@@ -697,13 +711,18 @@ def build_step_routed(bounds: Bounds, spec: str = "full",
         flat = jax.tree.map(lambda a: a.reshape((N,) + a.shape[2:]), succs)
         csucc = jax.tree.map(lambda a: a[gidx], flat)
         csvecs = jax.vmap(lambda t: st.pack(t, jnp))(csucc)
+        ksucc, ksvecs = csucc, csvecs      # dedup-key inputs
+        if viewer is not None:
+            ksucc = jax.vmap(viewer)(csucc)
+            if not symmetry:
+                ksvecs = jax.vmap(lambda t: st.pack(t, jnp))(ksucc)
         if symmetry:
             if pallas_orbit_fp is not None:
-                cfp_hi, cfp_lo = pallas_orbit_fp(csvecs)
+                cfp_hi, cfp_lo = pallas_orbit_fp(ksvecs)
             else:
-                cfp_hi, cfp_lo = orbit_fp(csucc)
+                cfp_hi, cfp_lo = orbit_fp(ksucc)
         else:
-            cfp_hi, cfp_lo = fpr.fingerprint(csvecs, consts, jnp)
+            cfp_hi, cfp_lo = fpr.fingerprint(ksvecs, consts, jnp)
         if inv_fns:
             cinv_ok = jnp.stack([jax.vmap(f)(csucc) for f in inv_fns],
                                 axis=-1)

@@ -446,8 +446,15 @@ def py_orbit_fingerprint(s, bounds: Bounds,
 
 
 def init_fingerprint(config, init_py, init_vec) -> tuple:
-    """The dedup key of the initial state, orbit-reduced when the run has
-    SYMMETRY — one definition for every engine's table seeding."""
+    """The dedup key of the initial state, view-folded and orbit-reduced
+    per the config — one definition for every engine's table seeding."""
+    if getattr(config, "view", None):
+        from raft_tla_tpu.models import interp, views
+
+        viewed = views.py_view(config.view)(init_py, config.bounds)
+        if viewed is not init_py:
+            init_py = viewed
+            init_vec = interp.to_vec(viewed, config.bounds)
     if config.symmetry:
         return py_orbit_fingerprint(init_py, config.bounds, config.symmetry)
     consts = _host_consts(init_vec.shape[-1])

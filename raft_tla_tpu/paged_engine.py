@@ -85,7 +85,8 @@ def _build_segment(config: CheckConfig, caps: PagedCapacities, A: int,
     B = config.chunk
     n_inv = len(config.invariants)
     step = kernels.build_step(config.bounds, config.spec,
-                              tuple(config.invariants), config.symmetry)
+                              tuple(config.invariants), config.symmetry,
+                              view=config.view)
     Rcap, Lcap = caps.ring, caps.levels
     rmask = Rcap - 1
     BIG = jnp.int32(np.iinfo(np.int32).max)

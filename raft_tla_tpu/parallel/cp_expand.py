@@ -114,14 +114,14 @@ def build_cp_expand(bounds: Bounds, spec: str = "full", ndev: int = 1):
 
 def build_cp_step(bounds: Bounds, spec: str = "full",
                   invariants: tuple = (), symmetry: tuple = (),
-                  ndev: int = 1):
+                  ndev: int = 1, view: str | None = None):
     """The dense step's CP twin: ``step(vecs[B, W], dev) -> dict`` with
     ``svecs [B, A_local, W]``, ``valid``/``overflow`` ``[B, A_local]``,
     ``fp_hi/fp_lo``, ``inv_ok``, ``con_ok`` — per-lane values
     bit-identical to ``kernels.build_step`` at ``cp_lane_map``'s dense
     index.  Call inside ``shard_map`` with ``dev = lax.axis_index(axis)``.
     """
-    stages = kernels._step_stages(bounds, spec, invariants, symmetry)
+    stages = kernels._step_stages(bounds, spec, invariants, symmetry, view)
     lay = stages[0]
     expand = build_cp_expand(bounds, spec, ndev)
 

@@ -212,7 +212,8 @@ def _build_segment(config: CheckConfig, caps: DDDShardCapacities, A: int,
     if n_inv > 29:
         raise ValueError("at most 29 invariants (bit-packed into int32)")
     step = kernels.build_step(config.bounds, config.spec,
-                              tuple(config.invariants), config.symmetry)
+                              tuple(config.invariants), config.symmetry,
+                              view=config.view)
     OCAP = caps.seg_rows
     Csend = caps.send if caps.send is not None else BA
     nslice = ndev // nici

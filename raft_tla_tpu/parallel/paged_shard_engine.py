@@ -129,7 +129,8 @@ def _build_segment(config: CheckConfig, caps: PagedShardCapacities, A: int,
     if n_inv > 29:
         raise ValueError("at most 29 invariants (bit-packed int32 flags)")
     step = kernels.build_step(config.bounds, config.spec,
-                              tuple(config.invariants), config.symmetry)
+                              tuple(config.invariants), config.symmetry,
+                              view=config.view)
     Rcap, Lcap = caps.ring, caps.levels
     rmask = Rcap - 1
     Pw = schema.P

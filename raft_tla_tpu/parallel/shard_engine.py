@@ -231,7 +231,8 @@ def _build_segment(config: CheckConfig, caps: ShardCapacities,
     if n_inv > 29:
         raise ValueError("at most 29 invariants (bit-packed into int32 flags)")
     step = kernels.build_step(config.bounds, config.spec,
-                              tuple(config.invariants), config.symmetry)
+                              tuple(config.invariants), config.symmetry,
+                              view=config.view)
     Ncap, Lcap = caps.n_states, caps.levels
     Csend = caps.send if caps.send is not None else B * A
     nici = ndev if nici is None else nici

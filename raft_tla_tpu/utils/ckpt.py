@@ -49,10 +49,13 @@ def _stable(obj):
 
 
 def config_digest(config, caps, init_key: tuple) -> int:
-    # check_deadlock joins the identity only when on (default-omission, like
-    # _stable): resuming a non-deadlock checkpoint under --deadlock would
-    # silently skip dead states in the already-explored region.
+    # check_deadlock / view join the identity only when set (default-
+    # omission, like _stable): resuming a non-deadlock checkpoint under
+    # --deadlock would silently skip dead states in the already-explored
+    # region, and a view changes every dedup key.
     extras = (("check_deadlock", True),) if config.check_deadlock else ()
+    if getattr(config, "view", None):
+        extras += (("view", config.view),)
     key = repr((_stable(config.bounds), config.spec, config.invariants,
                 config.symmetry, config.chunk, _stable(caps),
                 init_key, *extras)).encode()
