@@ -664,19 +664,48 @@ def _check_liveness(args, config, props) -> int:
     from raft_tla_tpu.utils.render import render_state
 
     wf = () if args.wf.strip().lower() == "none" else         tuple(f.strip() for f in args.wf.split(",") if f.strip())
-    # Build the behavior graph once for all properties — on the device
-    # engine when one is selected (models/liveness.engine_graph reaches
-    # universes far past the interpreter), else with the interpreter.
+    # Build the behavior graph once for all properties.  Symmetric runs
+    # and the DDD engines use the DDD-store export (orbit-quotient
+    # soundness argument in liveness.ddd_graph; no device-table
+    # ceiling); other device engines keep the device_engine export; host
+    # engines use the interpreter.
     try:
-        if args.engine not in ("host", "ref") and not config.symmetry:
+        if args.engine in ("host", "ref"):
+            graph = liveness.explore_graph(config)
+        elif config.symmetry or args.engine in ("ddd", "ddd-shard",
+                                                "streamed"):
+            from raft_tla_tpu.ddd_engine import DDDCapacities
+            from raft_tla_tpu.models import spec as S
+            if config.symmetry:
+                print("Symmetry: liveness runs on the orbit-quotient "
+                      "graph (exact for the registered properties — "
+                      "models/liveness.ddd_graph); the lasso, if any, "
+                      "is a quotient witness")
+            A = len(S.action_table(config.bounds, config.spec))
+            graph = liveness.ddd_graph(config, DDDCapacities(
+                block=args.block or 1 << 20,
+                table=1 << max(10, min(26, (2 * args.cap - 1)
+                                       .bit_length())),
+                seg_rows=max(1 << 19, 2 * args.chunk * A),
+                levels=args.levels))
+        else:
             from raft_tla_tpu.device_engine import Capacities
             graph = liveness.engine_graph(config, Capacities(
                 n_states=args.cap, levels=args.levels))
-        else:
-            graph = liveness.explore_graph(config)
     except (ValueError, RuntimeError) as e:
         print(f"Error: {e}", file=sys.stderr)
         return EXIT_ERROR
+    try:
+        return _report_liveness(args, config, props, wf, graph)
+    finally:
+        if isinstance(graph[0], liveness.StatesView):
+            graph[0].close()        # the retained DDD host store
+
+
+def _report_liveness(args, config, props, wf, graph) -> int:
+    from raft_tla_tpu.models import liveness
+    from raft_tla_tpu.utils.render import render_state
+
     for nm in props:
         t0 = time.monotonic()
         try:

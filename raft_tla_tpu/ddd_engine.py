@@ -559,7 +559,8 @@ class DDDEngine:
               on_progress=None, checkpoint: str | None = None,
               checkpoint_every_s: float = 600.0,
               resume: str | None = None,
-              deadline_s: float | None = None) -> EngineResult:
+              deadline_s: float | None = None,
+              retain_store: bool = False) -> EngineResult:
         t0 = time.monotonic()
         bounds = self.bounds
         init_py = init_override if init_override is not None \
@@ -848,9 +849,14 @@ class DDDEngine:
         if tail > 0:                 # partial final level (stopped run)
             levels_arr.append(tail)
         coverage = aggregate_coverage(self.table, cov)
-        host.close()
-        constore.close()
-        keystore.close()
+        if retain_store:
+            # graph exports (models/liveness.ddd_graph) re-expand the
+            # stored rows; the caller owns closing these
+            self.retained = (host, constore, keystore, n_states)
+        else:
+            host.close()
+            constore.close()
+            keystore.close()
         return EngineResult(
             n_states=n_states, diameter=len(levels_arr) - 1,
             n_transitions=n_trans, coverage=coverage,

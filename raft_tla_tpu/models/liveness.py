@@ -69,6 +69,19 @@ PROPERTIES = {
     "InfinitelyOftenLeader": (INFINITELY_OFTEN, _some_leader),
 }
 
+# Vectorized twins over unpacked struct-of-arrays chunks (leading batch
+# dim), for predicate evaluation at engine-store scale — a million
+# PyState materializations just to test `any(role == Leader)` is the
+# kind of host loop the graph exports exist to avoid.  Every registered
+# predicate is PERMUTATION-INVARIANT (reads role/commitIndex as sets),
+# which is what makes the orbit-quotient check of ddd_graph sound.
+_STRUCT_PREDICATES = {
+    "EventuallyLeader": lambda st_, b: (st_["role"] == S.LEADER).any(-1),
+    "EventuallyCommit": lambda st_, b: (st_["commitIndex"] > 0).any(-1),
+    "InfinitelyOftenLeader":
+        lambda st_, b: (st_["role"] == S.LEADER).any(-1),
+}
+
 
 @dataclasses.dataclass
 class LassoViolation:
@@ -223,6 +236,141 @@ def engine_graph(config: CheckConfig, caps=None):
     return states, edges, enabled, expanded
 
 
+class StatesView:
+    """Lazy state access over a retained DDD host store: ``states[u]``
+    materializes one PyState on demand (trace rendering), ``mask(prop)``
+    evaluates a registered predicate vectorized over packed-row chunks
+    (the scale path — no per-state Python objects)."""
+
+    def __init__(self, host, schema, lay, bounds, n: int,
+                 batch: int = 1 << 14):
+        import numpy as np
+
+        self._host, self._schema, self._lay = host, schema, lay
+        self._bounds, self._n, self._batch = bounds, n, batch
+        self._np = np
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, u: int):
+        from raft_tla_tpu.ops import state as st
+
+        np = self._np
+        row = self._schema.unpack(self._host.read(int(u), 1), np)[0]
+        return interp.from_struct(st.unpack(row, self._lay, np),
+                                  self._bounds)
+
+    def mask(self, prop: str):
+        """Vectorized ``[n]`` bool array of the property's predicate;
+        falls back to the scalar predicate for properties without a
+        registered vector twin."""
+        from raft_tla_tpu.ops import state as st
+
+        np = self._np
+        fn = _STRUCT_PREDICATES.get(prop)
+        if fn is None:
+            _form, pred = PROPERTIES[prop]
+            return np.asarray([pred(self[u], self._bounds)
+                               for u in range(self._n)], bool)
+        out = np.zeros((self._n,), bool)
+        for c0 in range(0, self._n, self._batch):
+            nb = min(self._batch, self._n - c0)
+            vecs = self._schema.unpack(self._host.read(c0, nb), np)
+            out[c0:c0 + nb] = fn(st.unpack(vecs, self._lay, np),
+                                 self._bounds)
+        return out
+
+    def close(self) -> None:
+        self._host.close()
+
+
+def ddd_graph(config: CheckConfig, caps=None):
+    """:func:`engine_graph` on the DDD architecture — graph exports past
+    every device-table ceiling, SYMMETRY included (VERDICT r2 weak #5).
+
+    Runs the DDD engine (exact dedup in host RAM), keeps its stores, and
+    re-expands the stored rows chunkwise to emit labeled edges, resolving
+    successor keys through the key log.  Returns
+    ``(states, edges, enabled, expanded)`` where ``states`` is a lazy
+    :class:`StatesView` (``check`` uses its vectorized predicate mask).
+
+    **Symmetry soundness** (why this builder accepts what engine_graph
+    rejects): under SYMMETRY the engine's graph IS the orbit quotient,
+    and for this module's fairness semantics the quotient check is
+    exact, by the standard argument —
+
+    - every registered predicate is permutation-invariant (set-level
+      reads of role/commitIndex), so the ~P region is a union of orbits;
+    - WF is per action FAMILY, and families are permutation-closed, so
+      family-enabledness is orbit-invariant;
+    - a fair lasso in the full graph projects to a fair lasso in the
+      quotient (steps project to steps, labels keep their family,
+      disabledness is orbit-invariant); conversely a fair quotient cycle
+      lifts: replay its actions from any concrete member — each leg
+      lands in the next orbit, and after at most |G| traversals the
+      concrete walk revisits a state, closing a concrete cycle that
+      takes the same family steps (and visits permuted copies of the
+      same disabled-witness orbits), hence is fair.
+
+    The rendered counterexample is therefore a QUOTIENT lasso: each
+    shown state is an orbit representative, and consecutive steps are
+    real transitions modulo a server/value permutation — the same
+    witness form TLC prints for symmetric liveness runs.
+    """
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tla_tpu.ddd_engine import DDDEngine
+    from raft_tla_tpu.ops import kernels
+    from raft_tla_tpu.ops import state as st
+    from raft_tla_tpu.utils import keyset
+
+    cfg = _dc.replace(config, invariants=(), check_deadlock=False)
+    eng = DDDEngine(cfg, caps)
+    eng.check(retain_store=True)
+    host, constore, keystore, n = eng.retained
+    bounds = cfg.bounds
+    lay, schema = eng.lay, eng.schema
+    table = eng.table
+    A, B = eng.A, cfg.chunk
+
+    kw = keystore.read(0, n).view(np.uint32)
+    keys = keyset.pack_keys(kw[:, 1], kw[:, 0])
+    index = {int(k): i for i, k in enumerate(keys)}
+    expanded = constore.read(0, n)[:, 0].astype(bool)
+    constore.close()
+    keystore.close()
+
+    step = jax.jit(kernels.build_step(bounds, cfg.spec, (),
+                                      cfg.symmetry, view=cfg.view))
+    fam_of = [inst.family for inst in table]
+    edges: list = [[] for _ in range(n)]
+    enabled: list = [set() for _ in range(n)]
+    for c0 in range(0, n, B):
+        nb = min(B, n - c0)
+        vecs = schema.unpack(host.read(c0, nb), np)
+        if nb < B:
+            vecs = np.concatenate(
+                [vecs, np.broadcast_to(vecs[:1], (B - nb, vecs.shape[1]))])
+        out = step(jnp.asarray(vecs))
+        valid = np.asarray(out["valid"])[:nb]
+        skeys = keyset.pack_keys(
+            np.asarray(out["fp_hi"])[:nb].reshape(nb, A),
+            np.asarray(out["fp_lo"])[:nb].reshape(nb, A))
+        for b, a in zip(*np.nonzero(valid)):
+            u = c0 + int(b)
+            enabled[u].add(fam_of[a])
+            if expanded[u]:
+                edges[u].append((int(a), index[int(skeys[b, a])]))
+
+    states = StatesView(host, schema, lay, bounds, n)
+    return states, edges, enabled, [bool(x) for x in expanded]
+
+
 def _sccs(n: int, adj) -> list:
     """Iterative Tarjan; returns SCCs as lists of node ids."""
     UNVISITED = -1
@@ -319,7 +467,8 @@ def check(config: CheckConfig, prop: str,
     states, edges, enabled, expanded = graph if graph is not None \
         else explore_graph(config)
     n = len(states)
-    p_mask = [pred(s, bounds) for s in states]
+    p_mask = states.mask(prop) if isinstance(states, StatesView) \
+        else [pred(s, bounds) for s in states]
 
     # The candidate cycle region: ~P states; edges must stay inside it.
     allowed = [not p for p in p_mask]

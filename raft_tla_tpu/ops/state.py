@@ -170,11 +170,14 @@ def pack(struct, xp):
 
 
 def unpack(vec, lay: Layout, xp):
-    """Flat int32[W] vector -> struct."""
+    """int32[..., W] vector(s) -> struct (leading batch dims pass
+    through: [W] -> per-field ``shape``, [C, W] -> ``(C,) + shape``)."""
     out, off = {}, 0
+    batch = tuple(vec.shape[:-1])
     for f, shape in lay.shapes.items():
         size = int(np.prod(shape))
-        out[f] = xp.reshape(vec[off:off + size], shape).astype(xp.int32)
+        out[f] = xp.reshape(vec[..., off:off + size],
+                            batch + tuple(shape)).astype(xp.int32)
         off += size
     return out
 
