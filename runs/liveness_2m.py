@@ -1,0 +1,45 @@
+"""VERDICT r2 weak#5 'done' gate: an EventuallyLeader verdict on a
+>=1M-state graph from the DDD-store export (no device-table ceiling).
+
+The 3-server election t2/m2 universe: 2,053,427 states, 4,087,611
+transitions (refbfs-pinned).  Writes one JSON line per verdict to
+runs/liveness_2m.out.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.ddd_engine import DDDCapacities
+from raft_tla_tpu.models import liveness
+
+cfg = CheckConfig(
+    bounds=Bounds(n_servers=3, n_values=1, max_term=2, max_log=0,
+                  max_msgs=2),
+    spec="election", invariants=(), chunk=4096)
+caps = DDDCapacities(block=1 << 17, table=1 << 22, flush=1 << 20,
+                     levels=128)
+t0 = time.time()
+graph = liveness.ddd_graph(cfg, caps)
+t_graph = time.time() - t0
+print(json.dumps({"phase": "graph", "n_states": len(graph[0]),
+                  "n_edges": sum(map(len, graph[1])),
+                  "wall_s": round(t_graph, 1)}), flush=True)
+for prop, wf in [("EventuallyLeader", ("Next",)),
+                 ("EventuallyLeader", ()),
+                 ("InfinitelyOftenLeader", ("Next",))]:
+    t1 = time.time()
+    r = liveness.check(cfg, prop, wf=wf, graph=graph)
+    print(json.dumps({
+        "prop": prop, "wf": list(wf), "holds": r.holds,
+        "n_states": r.n_states, "n_edges": r.n_edges,
+        "n_sccs_checked": r.n_sccs_checked,
+        "cycle_len": len(r.violation.cycle) if r.violation else None,
+        "wall_s": round(time.time() - t1, 1)}), flush=True)

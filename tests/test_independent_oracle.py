@@ -20,35 +20,106 @@ from raft_tla_tpu.models import refbfs
 
 
 # Hand-derived in runs/worksheet_levels.md, action family by action family
-# from raft.tla:155-465 with explicit set-counting: levels 0-3 of the
+# from raft.tla:155-465 with explicit set-counting: levels 0-4 of the
 # reference raft.cfg universe under the t2/l1/m2 constraint.
-WORKSHEET_LEVELS = [1, 3, 18, 76]
+WORKSHEET_LEVELS = [1, 3, 18, 76, 279]
+
+# Level 4's 27 hand-derived families and their sizes (worksheet "Level
+# 4" section, same order of magnitude grouping).
+WORKSHEET_L4_FAMILIES = sorted(
+    [45, 36, 30, 18, 18, 12, 12] + [9] * 5 + [6] * 6 + [3] * 9,
+    reverse=True)
 
 
-def test_worksheet_levels_all_three_implementations():
-    b = Bounds(n_servers=3, n_values=2, max_term=2, max_log=1, max_msgs=2)
-    # the package oracle
-    from raft_tla_tpu.models import interp
-    init = interp.init_state(b)
+def _bfs_frontiers(init, succ, con, depth):
+    """Level-synchronous BFS (TLC CONSTRAINT semantics: CV states are
+    counted, never expanded); returns (per-level counts, last frontier).
+    One definition for every loop in this file — the level-count and
+    partition tests must never desynchronize on expansion semantics."""
     seen, frontier, levels = {init}, [init], [1]
-    for _ in range(4):
+    for _ in range(depth):
         nxt = []
         for s in frontier:
-            if not interp.constraint_ok(s, b):
+            if not con(s):
                 continue
-            for _i, t in interp.successors(s, b, spec="full"):
+            for t in succ(s):
                 if t not in seen:
                     seen.add(t)
                     nxt.append(t)
         levels.append(len(nxt))
         frontier = nxt
+    return levels, frontier
+
+
+def _pkg_frontiers(b, depth):
+    from raft_tla_tpu.models import interp
+
+    return _bfs_frontiers(
+        interp.init_state(b),
+        lambda s: (t for _i, t in interp.successors(s, b, spec="full")),
+        lambda s: interp.constraint_ok(s, b), depth)
+
+
+def _ora_frontiers(depth):
+    return _bfs_frontiers(
+        oracle.init_state(3),
+        lambda s: oracle.successors(s, 3, 2),
+        lambda s: oracle.constraint_ok(s, 2, 1, 2, 1), depth)
+
+
+def test_worksheet_levels_all_three_implementations():
+    b = Bounds(n_servers=3, n_values=2, max_term=2, max_log=1, max_msgs=2)
+    levels, _ = _pkg_frontiers(b, 5)
     # the independent transcription
     mini = oracle.bfs(n=3, values=2, max_term=2, max_log=1, max_msgs=2,
-                      max_levels=4)
-    assert levels[:4] == WORKSHEET_LEVELS
-    assert mini[:4] == WORKSHEET_LEVELS
+                      max_levels=5)
+    assert levels[:5] == WORKSHEET_LEVELS
+    assert mini[:5] == WORKSHEET_LEVELS
     # beyond the hand-derived prefix the two interpreters must still agree
-    assert levels[4] == mini[4]
+    assert levels[5] == mini[5]
+
+
+def test_worksheet_level4_partition():
+    """The worksheet's 27 level-4 families (hand-derived counts) must
+    partition the actual level-4 states of BOTH interpreters — and the
+    two partitions must be identical class by class, not just in size.
+    The signature (per-server (role, term, votedFor?, votes?) multiset,
+    bag shape, CV flag) separates exactly the worksheet's families."""
+    from collections import Counter
+
+    from raft_tla_tpu.models import interp
+
+    b = Bounds(n_servers=3, n_values=2, max_term=2, max_log=1, max_msgs=2)
+    _levels, frontier = _pkg_frontiers(b, 4)
+
+    def sig_pkg(s):
+        per = tuple(sorted(
+            (r, t, vf != 0, (vr | vg) != 0)
+            for r, t, vf, vr, vg in zip(s.role, s.term, s.votedFor,
+                                        s.vResp, s.vGrant)))
+        return (per, len(s.msgs),
+                tuple(sorted(c for _m, c in s.msgs)),
+                not interp.constraint_ok(s, b))
+
+    cp = Counter(sig_pkg(s) for s in frontier)
+    assert sorted(cp.values(), reverse=True) == WORKSHEET_L4_FAMILIES
+
+    role_code = {oracle.FOLLOWER: 0, oracle.CANDIDATE: 1,
+                 oracle.LEADER: 2}
+    _olevels, ofrontier = _ora_frontiers(4)
+
+    def sig_ora(s):
+        per = tuple(sorted(
+            (role_code[r], t, vf is not None, bool(vr or vg))
+            for r, t, vf, vr, vg in zip(s.role, s.currentTerm,
+                                        s.votedFor, s.votesResponded,
+                                        s.votesGranted)))
+        return (per, len(s.messages),
+                tuple(sorted(c for _m, c in s.messages)),
+                not oracle.constraint_ok(s, 2, 1, 2, 1))
+
+    co = Counter(sig_ora(s) for s in ofrontier)
+    assert co == cp
 
 
 def test_full_2s1v_space_matches_package_oracle():
