@@ -127,6 +127,11 @@ def build_argparser() -> argparse.ArgumentParser:
                         "source run when resuming or resharding — the "
                         "reshard summary prints the value to resume "
                         "with)")
+    p.add_argument("--cp-lanes", action="store_true",
+                   help="--engine ddd-shard only: CP mode — shard the "
+                        "bag-scan ACTION lanes across the mesh instead "
+                        "of the frontier rows (window replicated; see "
+                        "RESULTS.md 'CP measured' before choosing it)")
     p.add_argument("--view", default=None, choices=("deadvotes",),
                    help="TLC VIEW analog: fold a registered EXACT view "
                         "into every dedup key (models/views.py carries "
@@ -415,7 +420,7 @@ def _run(args, config):
         blk = args.block or _ddd_shard_block(args.chunk)
         eng = DDDShardEngine(config, mesh, DDDShardCapacities(
             block=blk, table=table, seg_rows=seg_rows,
-            levels=args.levels))
+            levels=args.levels, cp=args.cp_lanes))
         return eng.check(on_progress=_stats_cb(args),
                          checkpoint=args.checkpoint,
                          checkpoint_every_s=args.checkpoint_every,
@@ -591,14 +596,18 @@ def main(argv=None) -> int:
                 return EXIT_ERROR
             ndev_src = args.devices
             blk_src = args.block or _ddd_shard_block(args.chunk)
-        w_src = ndev_src * blk_src
+        # CP-mode windows are block rows regardless of mesh size (the
+        # window replicates), so the window math is ndev-independent
+        cp = args.engine == "ddd-shard" and args.cp_lanes
+        w_src = blk_src if cp else ndev_src * blk_src
         # destination block: prefer preserving the GLOBAL window size
         # (every snapshot boundary shared), else keep the source block;
         # either way it must be chunk-aligned or the mesh engine would
         # reject the digest-pinned block at resume — refuse loudly here
         # instead of writing an unusable snapshot
-        cand = ([w_src // args.reshard_to]
-                if w_src % args.reshard_to == 0 else []) + [blk_src]
+        cand = [blk_src] if cp else (
+            ([w_src // args.reshard_to]
+             if w_src % args.reshard_to == 0 else []) + [blk_src])
         blk_dst = next((b for b in cand
                         if b > 0 and b % args.chunk == 0), None)
         if blk_dst is None:
@@ -611,10 +620,11 @@ def main(argv=None) -> int:
         try:
             info = reshard_ddd_checkpoint(
                 config,
-                DDDShardCapacities(block=blk_src, levels=args.levels),
+                DDDShardCapacities(block=blk_src, levels=args.levels,
+                                   cp=cp),
                 args.resume, args.checkpoint, ndev_src, args.reshard_to,
                 caps_dst=DDDShardCapacities(block=blk_dst,
-                                            levels=args.levels))
+                                            levels=args.levels, cp=cp))
         except Exception as e:
             print(f"Error: {e}", file=sys.stderr)
             return EXIT_ERROR
@@ -623,7 +633,8 @@ def main(argv=None) -> int:
               f"{info['rows_done']} frontier rows done "
               f"({info['blocks_done_dst']} windows) -> "
               f"{args.checkpoint}  [resume with --engine ddd-shard "
-              f"--devices {info['ndev_dst']} --block {blk_dst}]")
+              f"--devices {info['ndev_dst']} --block {blk_dst}"
+              f"{' --cp-lanes' if cp else ''}]")
         return EXIT_OK
 
     t0 = time.monotonic()

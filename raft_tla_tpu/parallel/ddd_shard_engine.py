@@ -112,6 +112,14 @@ class DDDShardCapacities:
     levels: int = 1 << 12
     send: Optional[int] = None
     send2: Optional[int] = None
+    # CP mode (SURVEY §2.9 CP row): every shard expands the SAME window
+    # rows over its lane slice (parallel/cp_expand) instead of its own
+    # row slice over all lanes — the bag-scan axis shards, the frontier
+    # replicates.  Owner exchange, filters and host dedup are unchanged;
+    # discovery order is (chunk, lane-slice shard, slot) and joins the
+    # digest.  Pays only when bag lanes dominate the fan-out; see the
+    # RESULTS.md measurement before choosing it.
+    cp: bool = False
 
     def __post_init__(self):
         # table is bitmask-addressed (power of two); block is only window
@@ -136,6 +144,7 @@ class _DigestCaps:
     block: int = 1 << 20
     levels: int = 1 << 12
     ndev: int = 1
+    cp: bool = False
 
 
 class MFilter(NamedTuple):
@@ -207,13 +216,25 @@ def _build_segment(config: CheckConfig, caps: DDDShardCapacities, A: int,
     """One watchdog-safe lockstep slice (<= budget chunks) of the
     window expansion, under shard_map."""
     B = config.chunk
-    BA = B * A
     n_inv = len(config.invariants)
     if n_inv > 29:
         raise ValueError("at most 29 invariants (bit-packed into int32)")
-    step = kernels.build_step(config.bounds, config.spec,
-                              tuple(config.invariants), config.symmetry,
-                              view=config.view)
+    if caps.cp:
+        from raft_tla_tpu.parallel import cp_expand as cpx
+
+        step = cpx.build_cp_step(config.bounds, config.spec,
+                                 tuple(config.invariants),
+                                 config.symmetry, ndev=ndev,
+                                 view=config.view)
+        A_loc = cpx.cp_lane_count(config.bounds, config.spec, ndev)
+        lane_map = jnp.asarray(cpx.cp_lane_map(config.bounds, config.spec,
+                                               ndev))     # [ndev, A_loc]
+    else:
+        step = kernels.build_step(config.bounds, config.spec,
+                                  tuple(config.invariants),
+                                  config.symmetry, view=config.view)
+        A_loc = A
+    BA = B * A_loc
     OCAP = caps.seg_rows
     Csend = caps.send if caps.send is not None else BA
     nslice = ndev // nici
@@ -246,21 +267,33 @@ def _build_segment(config: CheckConfig, caps: DDDShardCapacities, A: int,
             cur, nva, fa = cursor[0], n_valid[0], fail[0]
             vpos, vinv, dg = viol_pos[0], viol_inv[0], dead_g[0]
 
-            # ---- expand my chunk of my window slice ----
+            # ---- expand my chunk (my row slice, or in CP mode the
+            # shared rows over my lane slice) ----
             r0 = c * B
             rows_l = r0 + jnp.arange(B, dtype=I32)
             row_act = rows_l < nrows[0]
             bidx = jnp.minimum(rows_l, caps.block - 1)
             vecs = schema.unpack(fbuf[bidx], jnp)
             row_ok = row_act & fcon[bidx]
-            out = step(vecs)
+            if caps.cp:
+                dev = jax.lax.axis_index(_AXIS).astype(I32) \
+                    if nslice == 1 else (
+                        jax.lax.axis_index(_DCN).astype(I32) * nici
+                        + jax.lax.axis_index(_AXIS).astype(I32))
+                out = step(vecs, dev)
+            else:
+                out = step(vecs)
             valid = out["valid"] & row_ok[:, None]
             fvalid = valid.reshape(BA)
             nva = nva + jnp.sum(fvalid.astype(I32))
             fa = fa | jnp.any(fvalid & out["overflow"].reshape(BA)) \
                 .astype(I32) * FAIL_WIDTH
             if config.check_deadlock:
-                dead = row_ok & ~jnp.any(out["valid"], axis=1)
+                en = jnp.any(out["valid"], axis=1)
+                if caps.cp:
+                    # a row's enabled lanes are sliced across the mesh
+                    en = jax.lax.psum(en.astype(I32), axes) > 0
+                dead = row_ok & ~en
                 drow = jnp.min(jnp.where(dead, jnp.arange(B, dtype=I32),
                                          BIG))
                 dg = jnp.where((drow < BIG) & (dg < 0),
@@ -270,8 +303,13 @@ def _build_segment(config: CheckConfig, caps: DDDShardCapacities, A: int,
             fhi = out["fp_hi"].reshape(BA)
             flo = out["fp_lo"].reshape(BA)
             svecs = schema.pack(out["svecs"].reshape(BA, W), jnp)
-            par_g = fpar[r0 + jnp.arange(BA, dtype=I32) // A]
-            lane_a = jnp.arange(BA, dtype=I32) % A
+            par_g = fpar[r0 + jnp.arange(BA, dtype=I32) // A_loc]
+            if caps.cp:
+                # dense action-table index of each local lane (coverage
+                # attribution and trace labels are table-global)
+                lane_a = lane_map[dev][jnp.arange(BA, dtype=I32) % A_loc]
+            else:
+                lane_a = jnp.arange(BA, dtype=I32) % A_loc
             flags = jnp.ones((BA,), I32) | (
                 out["con_ok"].reshape(BA).astype(I32) << 1)
             if n_inv:
@@ -386,7 +424,8 @@ class DDDShardEngine:
         self.seg_chunks = seg_chunks
         self._digest_caps = _DigestCaps(block=self.caps.block,
                                         levels=self.caps.levels,
-                                        ndev=self.ndev)
+                                        ndev=self.ndev,
+                                        cp=self.caps.cp)
         self.schema = bitpack.BitSchema(self.bounds)
         axes = _mesh_axes(self.mesh)
         nici = self.mesh.shape[_AXIS]
@@ -447,11 +486,21 @@ class DDDShardEngine:
         if self._gbuf is None:
             self._gbuf = np.zeros((nd * Fcap, self.schema.P), np.int32)
             self._gcon = np.zeros((nd * Fcap,), bool)
-        self._gbuf[:wrows] = host.read(wbase, wrows)
-        self._gcon[:wrows] = constore.read(wbase, wrows)[:, 0]
-        gpar = (wbase + np.arange(nd * Fcap)).astype(np.int32)
-        nrows = np.clip(wrows - np.arange(nd) * Fcap, 0, Fcap) \
-            .astype(np.int32)
+        if self.caps.cp:
+            # CP mode: every shard expands the SAME rows (its lane slice)
+            blk = host.read(wbase, wrows)
+            con = constore.read(wbase, wrows)[:, 0]
+            for s in range(nd):
+                self._gbuf[s * Fcap:s * Fcap + wrows] = blk
+                self._gcon[s * Fcap:s * Fcap + wrows] = con
+            gpar = np.tile(wbase + np.arange(Fcap), nd).astype(np.int32)
+            nrows = np.full((nd,), wrows, np.int32)
+        else:
+            self._gbuf[:wrows] = host.read(wbase, wrows)
+            self._gcon[:wrows] = constore.read(wbase, wrows)[:, 0]
+            gpar = (wbase + np.arange(nd * Fcap)).astype(np.int32)
+            nrows = np.clip(wrows - np.arange(nd) * Fcap, 0, Fcap) \
+                .astype(np.int32)
         sh = self._in_shardings
         return (jax.device_put(self._gbuf, sh[0]),
                 jax.device_put(self._gcon, sh[1]),
@@ -614,7 +663,9 @@ class DDDShardEngine:
                 for _ in range(self.ndev)]
         staging = [{"keys": [], "rows": [], "par": [], "lane": [],
                     "con": []} for _ in range(self.ndev)]
-        W = self.ndev * self.caps.block       # global window rows
+        # global window rows: row-sharded in DP mode, replicated in CP
+        W = self.caps.block if self.caps.cp \
+            else self.ndev * self.caps.block
         OCAP = self.caps.seg_rows
         fail = 0
         viol = None        # (kind, inv_idx, key_or_gid) once detected
@@ -873,13 +924,14 @@ def reshard_ddd_checkpoint(config: CheckConfig,
     init_key = (hi0, lo0)
     src_digest = ckpt.config_digest(
         config, _DigestCaps(block=caps_src.block, levels=caps_src.levels,
-                            ndev=ndev_src), init_key)
+                            ndev=ndev_src, cp=caps_src.cp), init_key)
     with ckpt.load_npz_checked(src_path, src_digest) as z:
         fields = {k: np.asarray(z[k]).copy() for k in
                   ("n_states", "n_trans", "cov", "level_ends",
                    "blocks_done")}
-    rows_done = int(fields["blocks_done"]) * ndev_src * caps_src.block
-    w_dst = ndev_dst * caps_dst.block
+    rows_done = int(fields["blocks_done"]) * (
+        caps_src.block if caps_src.cp else ndev_src * caps_src.block)
+    w_dst = caps_dst.block if caps_dst.cp else ndev_dst * caps_dst.block
     # a partial final level window is clamped by the level size; rows
     # actually expanded = min(rows_done, current level rows)
     le = [int(x) for x in fields["level_ends"]]
@@ -903,7 +955,8 @@ def reshard_ddd_checkpoint(config: CheckConfig,
         dst_path, **fields,
         config_digest=np.uint64(ckpt.config_digest(
             config, _DigestCaps(block=caps_dst.block,
-                                levels=caps_dst.levels, ndev=ndev_dst),
+                                levels=caps_dst.levels, ndev=ndev_dst,
+                                cp=caps_dst.cp),
             init_key)))
     return {"ndev_src": ndev_src, "ndev_dst": ndev_dst,
             "n_states": n_states, "rows_done": rows_done,

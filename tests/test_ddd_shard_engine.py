@@ -11,6 +11,8 @@ and checkpoint resharding across mesh sizes (including adopting a
 single-chip campaign checkpoint onto a mesh).
 """
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -228,6 +230,89 @@ def test_adopt_single_chip_checkpoint(tmp_path):
     ref = refbfs.check(CFG)
     got = DDDShardEngine(CFG, make_mesh(4), caps_dst).check(resume=ckm)
     assert_totals(got, ref)
+
+
+def test_cp_mode_parity_8dev():
+    """CP mode (lane-sliced expansion over a replicated window) must
+    explore the identical state graph: oracle-exact totals on an
+    m4-heavy config where the bag lanes dominate the fan-out — the
+    regime SURVEY §2.9's CP row targets."""
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=4, max_dup=2),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=32)
+    ref = refbfs.check(cfg)
+    caps = DDDShardCapacities(block=256, table=1 << 12, seg_rows=1 << 15,
+                              flush=1 << 10, levels=64, cp=True)
+    got = DDDShardEngine(cfg, make_mesh(8), caps).check()
+    assert_totals(got, ref)
+    # every lane family still gets credited (lane ids are table-dense)
+    assert got.coverage.keys() == ref.coverage.keys()
+
+
+def test_cp_mode_deadlock_and_violation():
+    """The cross-shard enabled-lane psum must not miss deadlocks, and
+    violations carry valid traces (dense lane labels)."""
+    from raft_tla_tpu.models import invariants as inv_mod
+
+    dl = CheckConfig(bounds=Bounds(n_servers=1, n_values=1, max_term=2,
+                                   max_log=0, max_msgs=2),
+                     spec="election", invariants=(), chunk=16,
+                     check_deadlock=True)
+    caps = DDDShardCapacities(block=64, table=1 << 7, seg_rows=1 << 12,
+                              flush=1 << 8, levels=64, cp=True)
+    ref = refbfs.check(dl)
+    got = DDDShardEngine(dl, make_mesh(8), caps).check()
+    assert got.violation is not None
+    assert got.violation.invariant == ref.violation.invariant
+    assert not list(interp.successors(got.violation.state, dl.bounds,
+                                      spec="election"))
+
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=4, max_dup=1)
+    vcfg = CheckConfig(bounds=bounds, spec="election",
+                       invariants=("NaiveNoTwoLeaders",), chunk=64)
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3), votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100),
+        msgs=tuple(sorted((m, 1) for m in
+                          (mb.rv_response(3, 1, 1, 2),))))
+    caps_v = DDDShardCapacities(block=1 << 12, table=1 << 14,
+                                seg_rows=1 << 16, flush=1 << 12,
+                                levels=64, cp=True)
+    gv = DDDShardEngine(vcfg, make_mesh(8), caps_v).check(
+        init_override=start)
+    assert gv.violation is not None
+    assert gv.violation.invariant == "NaiveNoTwoLeaders"
+    trace = gv.violation.trace
+    for (_l, prev), (_label, cur) in zip(trace, trace[1:]):
+        succs = [t for _i, t in interp.successors(prev, bounds,
+                                                  spec="election")]
+        assert cur in succs
+    assert not inv_mod.py_invariant("NaiveNoTwoLeaders")(
+        gv.violation.state, bounds)
+
+
+def test_cp_mode_checkpoint_resume(tmp_path):
+    cfg = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=4, max_dup=2),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=32)
+    caps = DDDShardCapacities(block=256, table=1 << 12, seg_rows=1 << 15,
+                              flush=1 << 10, levels=64, cp=True)
+    ck = str(tmp_path / "cp.ckpt")
+    mesh = make_mesh(8)
+    straight = DDDShardEngine(cfg, mesh, caps).check()
+    DDDShardEngine(cfg, mesh, caps).check(checkpoint=ck,
+                                          checkpoint_every_s=0.0)
+    resumed = DDDShardEngine(cfg, mesh, caps).check(resume=ck)
+    assert resumed.n_states == straight.n_states
+    assert resumed.levels == straight.levels
+    # a dense-mode engine must refuse a CP snapshot (order differs)
+    dense = dataclasses.replace(caps, cp=False)
+    with pytest.raises(ValueError, match="digest|different model"):
+        DDDShardEngine(cfg, mesh, dense).check(resume=ck)
 
 
 def test_full_spec_small_parity_8dev():
