@@ -785,20 +785,24 @@ def reshard_checkpoint(config: CheckConfig, caps_src: ShardCapacities,
     order = np.argsort(grp, kind="stable")
     devs, rows, grp = devs[order], rows[order], grp[order]
     vecs_all = np.ascontiguousarray(store[devs, rows])
+    del store, order            # at 10^8-row rescue scale every full-
+    #                             store intermediate is multi-GB
+    #                             (round-2 advisor finding)
 
-    # fixed-size batches (last one padded) — one jit compile, not one per
-    # ragged tail size
+    # fixed-size batches (only the ragged tail padded) — one jit
+    # compile, no second full-store copy
     CH = 8192
-    Mp = -(-M // CH) * CH
-    vecs_pad = np.zeros((Mp, W), np.int32)
-    vecs_pad[:M] = vecs_all
-    keys_hi = np.empty((Mp,), np.uint32)
-    keys_lo = np.empty((Mp,), np.uint32)
-    for o in range(0, Mp, CH):
-        h, l = fp_batch(jnp.asarray(vecs_pad[o:o + CH]))
-        keys_hi[o:o + CH] = np.asarray(h)
-        keys_lo[o:o + CH] = np.asarray(l)
-    keys_hi, keys_lo = keys_hi[:M], keys_lo[:M]
+    keys_hi = np.empty((M,), np.uint32)
+    keys_lo = np.empty((M,), np.uint32)
+    for o in range(0, M, CH):
+        nb = min(CH, M - o)
+        chunk = vecs_all[o:o + nb]
+        if nb < CH:
+            chunk = np.concatenate(
+                [chunk, np.zeros((CH - nb, W), np.int32)])
+        h, l = fp_batch(jnp.asarray(chunk))
+        keys_hi[o:o + nb] = np.asarray(h)[:nb]
+        keys_lo[o:o + nb] = np.asarray(l)[:nb]
 
     # -- assign new owners, preserving sequence order per owner ------------
     owner_of = (keys_hi % np.uint32(ndev_dst)).astype(np.int64)
@@ -821,19 +825,31 @@ def reshard_checkpoint(config: CheckConfig, caps_src: ShardCapacities,
                                   minlength=ndev_dst).astype(np.int32)
 
     # -- rebuild the sharded leaves (vectorized scatters) ------------------
+    # The src carry's big arrays must actually die before the destination
+    # allocations: reshape views alone free nothing while ``src``/``arrs``
+    # stay referenced, so the small surviving fields are extracted first
+    # and the carry dropped wholesale (round-2 advisor finding).
     par_src = src.parent.reshape(nd_src, Ncap_s)
     lane_src = src.lane.reshape(nd_src, Ncap_s)
     con_src = src.conflag.reshape(nd_src, Ncap_s)
-    store_new = np.zeros((ndev_dst * Ncap_d, W), np.int32)
     parent_new = np.full((ndev_dst * Ncap_d,), -1, np.int32)
     lane_new = np.full((ndev_dst * Ncap_d,), -1, np.int32)
     con_new = np.zeros((ndev_dst * Ncap_d,), bool)
-    store_new[new_gid] = vecs_all
     p_old = par_src[devs, rows]
     parent_new[new_gid] = np.where(p_old >= 0, gid_map[np.maximum(p_old, 0)],
                                    -1).astype(np.int32)
     lane_new[new_gid] = lane_src[devs, rows]
     con_new[new_gid] = con_src[devs, rows]
+    n_trans_tot = sum(
+        acc64_int(src.n_trans.reshape(nd_src, 2)[d]) for d in range(nd_src))
+    cov_tot = src.cov.reshape(nd_src, A).sum(axis=0)
+    levels_src = np.asarray(src.levels).copy()
+    lvl_src = np.asarray(src.lvl).copy()
+    del par_src, lane_src, con_src, p_old, gid_map, src, arrs
+
+    store_new = np.zeros((ndev_dst * Ncap_d, W), np.int32)
+    store_new[new_gid] = vecs_all
+    del vecs_all                 # scattered; free before the table build
     TBd = caps_dst.table // BUCKET
     tbl_hi_new = np.full((ndev_dst * TBd, BUCKET), _EMPTY, np.uint32)
     tbl_lo_new = np.full((ndev_dst * TBd, BUCKET), _EMPTY, np.uint32)
@@ -860,25 +876,23 @@ def reshard_checkpoint(config: CheckConfig, caps_src: ShardCapacities,
         tbl_hi_new[o * TBd:(o + 1) * TBd] = np.asarray(th)
         tbl_lo_new[o * TBd:(o + 1) * TBd] = np.asarray(tl)
 
-    n_trans_tot = sum(
-        acc64_int(src.n_trans.reshape(nd_src, 2)[d]) for d in range(nd_src))
     n_trans_new = np.zeros((ndev_dst * 2,), np.uint32)
     n_trans_new[0] = np.uint32(n_trans_tot & 0xFFFFFFFF)
     n_trans_new[1] = np.uint32(n_trans_tot >> 32)
     cov_new = np.zeros((ndev_dst * A,), np.int32)
-    cov_new[:A] = src.cov.reshape(nd_src, A).sum(axis=0)
+    cov_new[:A] = cov_tot
 
     # the levels array is caps.levels long — resize to caps_dst (the
     # digest is written for caps_dst, so a mismatched length would
     # silently clamp deep-level accounting)
-    lvl_cur = int(np.asarray(src.lvl))
+    lvl_cur = int(lvl_src)
     if caps_dst.levels <= lvl_cur + 1:
         raise ValueError(
             f"caps_dst.levels={caps_dst.levels} too small: the run is "
             f"already at BFS level {lvl_cur}")
     levels_new = np.zeros((caps_dst.levels,), np.int32)
     n_keep = min(caps_src.levels, caps_dst.levels)
-    levels_new[:n_keep] = np.asarray(src.levels)[:n_keep]
+    levels_new[:n_keep] = levels_src[:n_keep]
 
     win = (le_new - ls_new).astype(np.int64)
     n_chunks = int(max(1, ((win + B - 1) // B).max()))
@@ -890,7 +904,7 @@ def reshard_checkpoint(config: CheckConfig, caps_src: ShardCapacities,
         viol_i=np.zeros((ndev_dst,), np.int32),
         n_trans=n_trans_new, cov=cov_new,
         fail=np.zeros((ndev_dst,), np.int32),
-        levels=levels_new, lvl=np.asarray(src.lvl),
+        levels=levels_new, lvl=lvl_src,
         c=np.int32(0), n_chunks=np.int32(n_chunks),
         stop=np.bool_(False))
     ckpt.atomic_savez(
