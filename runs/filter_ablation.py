@@ -1,0 +1,124 @@
+"""VERDICT r2 missing #4 closure: measure the DDD lossy-filter probe's
+share of the device step on the real chip, then decide the SURVEY §2.8
+Pallas dedup/probe kernel question with numbers (the EP write-up is the
+model: build-or-retire follows the measurement, either way recorded).
+
+Method: time, separately and at flagship shapes (3s/2v full Next,
+SYMMETRY Server, chunk 4096 → N = chunk*A candidate lanes; filter table
+2^26 slots), the two pieces of the per-chunk program:
+
+- ``step``: unpack → expand → canonicalize → pack → orbit fingerprint →
+  invariants → constraint (kernels.build_step) — the compute the filter
+  protects;
+- ``filter``: ddd_engine._filter_insert — two-sort first-occurrence +
+  one-gather bucket probe + insert at [N] against the 2^26-slot table.
+
+Each timed warm over many iterations with block_until_ready.  The
+filter fraction bounds what a Pallas probe kernel could save: if the
+gather is a few percent of the step, the kernel cannot pay (XLA already
+fuses the mask/select chain); if >20%, build it (VERDICT threshold).
+
+Writes one JSON line to stdout; run on the real chip (no --cpu).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.ddd_engine import _filter_insert
+from raft_tla_tpu.device_engine import _EMPTY, BUCKET
+from raft_tla_tpu.models import interp, spec as S
+from raft_tla_tpu.ops import kernels
+
+CFG = CheckConfig(
+    bounds=Bounds(n_servers=3, n_values=2, max_term=2, max_log=1,
+                  max_msgs=2, max_dup=1),
+    spec="full",
+    invariants=("NoTwoLeaders", "LogMatching", "CommittedWithinLog",
+                "LeaderCompleteness"),
+    symmetry=("Server",), chunk=4096)
+TABLE = 1 << 26
+REPS = 30
+
+
+def frontier_rows(n_rows: int) -> np.ndarray:
+    """A representative frontier: BFS a few levels, cycle the states
+    (init-only rows would leave most action guards disabled)."""
+    bounds = CFG.bounds
+    init = interp.init_state(bounds)
+    seen, frontier = {init}, [init]
+    rows = [interp.to_vec(init, bounds)]
+    while len(rows) < n_rows:
+        nxt = []
+        for s in frontier:
+            for _i, t in interp.successors(s, bounds, spec=CFG.spec):
+                if t not in seen:
+                    seen.add(t)
+                    nxt.append(t)
+                    rows.append(interp.to_vec(t, bounds))
+                    if len(rows) >= n_rows:
+                        break
+            if len(rows) >= n_rows:
+                break
+        frontier = nxt or frontier
+    return np.asarray(rows[:n_rows], np.int32)
+
+
+def timed(fn, *args, reps=REPS):
+    out = fn(*args)
+    jax.block_until_ready(out)        # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main() -> None:
+    A = len(S.action_table(CFG.bounds, CFG.spec))
+    B = CFG.chunk
+    N = B * A
+    step = jax.jit(kernels.build_step(CFG.bounds, CFG.spec,
+                                      tuple(CFG.invariants),
+                                      CFG.symmetry))
+    vecs = jnp.asarray(frontier_rows(B))
+    t_step = timed(step, vecs)
+    out = step(vecs)
+
+    TB = TABLE // BUCKET
+    tbl_hi = jnp.full((TB, BUCKET), _EMPTY, jnp.uint32)
+    tbl_lo = jnp.full((TB, BUCKET), _EMPTY, jnp.uint32)
+    kh = out["fp_hi"].reshape(N)
+    kl = out["fp_lo"].reshape(N)
+    act = out["valid"].reshape(N)
+    filt = jax.jit(_filter_insert, donate_argnums=(0, 1))
+
+    # donation consumes the table; rebuild per rep OUTSIDE the timing by
+    # timing a non-donating variant instead (the probe gather dominates
+    # either way; insert scatter identical)
+    filt_nd = jax.jit(_filter_insert)
+    t_filter = timed(filt_nd, tbl_hi, tbl_lo, kh, kl, act)
+
+    frac = t_filter / (t_step + t_filter)
+    print(json.dumps({
+        "chunk": B, "lanes": A, "candidates": N, "table_slots": TABLE,
+        "t_step_ms": round(t_step * 1e3, 3),
+        "t_filter_ms": round(t_filter * 1e3, 3),
+        "filter_fraction": round(frac, 4),
+        "verdict": ("build the Pallas probe kernel" if frac > 0.20
+                    else "filter is not the bottleneck — do not build"),
+        "backend": jax.devices()[0].platform,
+    }))
+
+
+if __name__ == "__main__":
+    main()

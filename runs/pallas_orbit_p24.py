@@ -1,0 +1,108 @@
+"""ADVICE r2 #1 closure: measure the Pallas orbit kernel at P=24 (the
+4-server Server-symmetry group) on the real chip — the
+_MAX_COMPILED_PERMS=24 gate was extrapolated from P=6 success and a
+P=120 VMEM failure, never measured at its own boundary.
+
+Compares the Pallas kernel against the lax.scan orbit pass on identical
+inputs (keys must be bit-identical) and times both warm.  Outcomes:
+- compile + parity + timing  -> record, keep the gate at 24;
+- Mosaic compile failure     -> lower the gate to the measured-good 6.
+
+Writes one JSON line to stdout; run on the real chip (no --cpu).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.models import interp
+from raft_tla_tpu.ops import fingerprint as fpr
+from raft_tla_tpu.ops import state as st
+from raft_tla_tpu.ops import symmetry as sym
+
+BOUNDS = Bounds(n_servers=4, n_values=1, max_term=2, max_log=0,
+                max_msgs=2)
+N_ROWS = 4096
+REPS = 20
+
+
+def frontier_rows(n_rows: int) -> np.ndarray:
+    init = interp.init_state(BOUNDS)
+    seen, frontier = {init}, [init]
+    rows = [interp.to_vec(init, BOUNDS)]
+    while len(rows) < n_rows:
+        nxt = []
+        for s in frontier:
+            for _i, t in interp.successors(s, BOUNDS, spec="election"):
+                if t not in seen:
+                    seen.add(t)
+                    nxt.append(t)
+                    rows.append(interp.to_vec(t, BOUNDS))
+                    if len(rows) >= n_rows:
+                        break
+            if len(rows) >= n_rows:
+                break
+        frontier = nxt or frontier
+    return np.asarray(rows[:n_rows], np.int32)
+
+
+def timed(fn, *args, reps=REPS):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main() -> None:
+    lay = st.Layout.of(BOUNDS)
+    consts = jnp.asarray(fpr.lane_constants(lay.width))
+    rows = frontier_rows(N_ROWS)
+    vecs = jnp.asarray(rows)
+
+    scan_fp = sym.build_orbit_fp(BOUNDS, ("Server",), consts, False)
+
+    @jax.jit
+    def scan_path(v):
+        structs = jax.vmap(lambda x: st.unpack(x, lay, jnp))(v)
+        return scan_fp(structs)
+
+    t_scan = timed(scan_path, vecs)
+    sh, sl = (np.asarray(x) for x in scan_path(vecs))
+
+    res = {"perms": 24, "rows": N_ROWS,
+           "t_scan_ms": round(t_scan * 1e3, 3),
+           "backend": jax.devices()[0].platform}
+    try:
+        from raft_tla_tpu.ops import pallas_orbit
+
+        pal = pallas_orbit.build_orbit_fp(BOUNDS, ("Server",), False)
+        if pal is None:
+            res["pallas"] = "declined (gate)"
+        else:
+            pal_j = jax.jit(pal)
+            t_pal = timed(pal_j, vecs)
+            ph, pl = (np.asarray(x) for x in pal_j(vecs))
+            res.update(
+                t_pallas_ms=round(t_pal * 1e3, 3),
+                keys_bit_identical=bool((ph == sh).all()
+                                        and (pl == sl).all()),
+                speedup_vs_scan=round(t_scan / t_pal, 3))
+    except Exception as e:                      # Mosaic compile failure
+        res["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
