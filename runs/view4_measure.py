@@ -1,0 +1,16 @@
+import sys; sys.path.insert(0, "/root/repo")
+import jax, json, time
+jax.config.update("jax_platforms", "cpu")
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+B = Bounds(n_servers=4, n_values=1, max_term=2, max_log=0, max_msgs=1)
+caps = DDDCapacities(block=1 << 17, table=1 << 22, flush=1 << 20, levels=128)
+out = {}
+for view in (None, "deadvotes"):
+    cfg = CheckConfig(bounds=B, spec="election",
+                      invariants=("NoTwoLeaders",), chunk=1024, view=view)
+    t = time.time()
+    r = DDDEngine(cfg, caps).check()
+    out[str(view)] = dict(n=r.n_states, d=r.diameter,
+                          viol=bool(r.violation), wall=round(time.time()-t, 1))
+    print(json.dumps(out), flush=True)
