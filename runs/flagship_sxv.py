@@ -1,0 +1,59 @@
+"""VERDICT r2 next #10: the flagship universe under SYMMETRY Server
+Value — both axes, |G| = 3! * 2! = 12.
+
+The Server-only flagship is 94,396,461 orbits (~566M raw states,
+diameter 57, re-verified bit-identically round 2 in 42.4 min).  The
+Server*Value quotient must be consistent: every SxV orbit count n_sxv
+satisfies  raw_states = sum over sxv orbits of |orbit|, and since the
+raw space is the same, n_sxv is bounded by [n_server/2, n_server]
+(Value adds a factor <= 2! = 2).  Diameter must be <= 57 (quotient
+paths only shorten).
+
+Runs on the DDD engine with a wall deadline (the chip window is
+shared with bench at round end); writes one JSON line per progress
+flush to runs/flagship_sxv.stats and the final result to stdout.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+
+RUNS = os.path.dirname(os.path.abspath(__file__))
+
+CFG = CheckConfig(
+    bounds=Bounds(n_servers=3, n_values=2, max_term=2, max_log=1,
+                  max_msgs=2, max_dup=1),
+    spec="full",
+    invariants=("NoTwoLeaders", "LogMatching", "CommittedWithinLog",
+                "LeaderCompleteness"),
+    symmetry=("Server", "Value"), chunk=4096)
+
+
+def main():
+    deadline = float(sys.argv[1]) if len(sys.argv) > 1 else 3000.0
+    sf = open(os.path.join(RUNS, "flagship_sxv.stats"), "a", buffering=1)
+    eng = DDDEngine(CFG, DDDCapacities(block=1 << 20, table=1 << 25,
+                                       flush=1 << 22, levels=128))
+    t0 = time.time()
+    r = eng.check(deadline_s=deadline,
+                  on_progress=lambda s: sf.write(json.dumps(s) + "\n"),
+                  checkpoint=os.path.join(RUNS, "flagship_sxv.ckpt"),
+                  checkpoint_every_s=600.0)
+    print(json.dumps({
+        "n_orbits": r.n_states, "diameter": r.diameter,
+        "n_transitions": r.n_transitions, "complete": r.complete,
+        "violation": r.violation.invariant if r.violation else None,
+        "wall_s": round(time.time() - t0, 1),
+        "levels": r.levels if r.complete else len(r.levels),
+    }))
+
+
+if __name__ == "__main__":
+    main()
