@@ -267,7 +267,13 @@ def _orbit_call(vecs, bounds, interpret=False):
 # count: measured 73.4M at P = 120 (5 servers) against the 16M limit on a
 # real v5e, while P = 6 (3 servers) compiles and runs bit-identically.
 # Beyond this bound the builder declines and callers use the scan path.
-_MAX_COMPILED_PERMS = 24
+# MEASURED boundary (round 3, runs/pallas_orbit_p24.py on the real
+# chip): P=24 (4-server group) fails Mosaic compilation outright
+# (remote_compile HTTP 500, tpu_compile_helper exit 1), so the earlier
+# extrapolated gate of 24 was too generous — only the measured-good
+# P=6 (3 servers) compiles.  The round-2 advisor predicted exactly
+# this; the gate now sits at the largest value ever seen to work.
+_MAX_COMPILED_PERMS = 6
 
 
 def build_orbit_fp(bounds: Bounds, axes: tuple, faithful: bool,

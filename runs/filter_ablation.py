@@ -60,6 +60,8 @@ def frontier_rows(n_rows: int) -> np.ndarray:
     while len(rows) < n_rows:
         nxt = []
         for s in frontier:
+            if not interp.constraint_ok(s, CFG.bounds):
+                continue
             for _i, t in interp.successors(s, bounds, spec=CFG.spec):
                 if t not in seen:
                     seen.add(t)

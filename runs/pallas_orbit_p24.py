@@ -42,6 +42,8 @@ def frontier_rows(n_rows: int) -> np.ndarray:
     while len(rows) < n_rows:
         nxt = []
         for s in frontier:
+            if not interp.constraint_ok(s, BOUNDS):
+                continue
             for _i, t in interp.successors(s, BOUNDS, spec="election"):
                 if t not in seen:
                     seen.add(t)
