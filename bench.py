@@ -106,7 +106,7 @@ def run_northstar() -> None:
         invariants=("NoTwoLeaders", "LogMatching", "CommittedWithinLog",
                     "LeaderCompleteness"),
         symmetry=("Server",), chunk=4096)
-    eng = DDDEngine(cfg, DDDCapacities(block=1 << 20, table=1 << 24,
+    eng = DDDEngine(cfg, DDDCapacities(block=1 << 20, table=1 << 22,
                                        flush=1 << 22, levels=128))
     stats: list = []
     r = eng.check(deadline_s=NORTHSTAR_DEADLINE_S, on_progress=stats.append)

@@ -132,7 +132,9 @@ def build_argparser() -> argparse.ArgumentParser:
                         "bag-scan ACTION lanes across the mesh instead "
                         "of the frontier rows (window replicated; see "
                         "RESULTS.md 'CP measured' before choosing it)")
-    p.add_argument("--view", default=None, choices=("deadvotes",),
+    from raft_tla_tpu.models.views import REGISTRY as _view_registry
+    p.add_argument("--view", default=None,
+                   choices=tuple(sorted(_view_registry)),
                    help="TLC VIEW analog: fold a registered EXACT view "
                         "into every dedup key (models/views.py carries "
                         "the soundness argument; deadvotes: zero "

@@ -317,6 +317,16 @@ def ddd_graph(config: CheckConfig, caps=None):
     shown state is an orbit representative, and consecutive steps are
     real transitions modulo a server/value permutation — the same
     witness form TLC prints for symmetric liveness runs.
+
+    **Practical size bound** (ADVICE r3 #2): the export itself is now
+    flat-array — sorted-key ``searchsorted`` successor resolution, CSR
+    edge storage (12 B/edge via :class:`_CSREdges`), one bool per
+    (state, family) for enabledness — so its footprint is ~keys (8 B) +
+    rows + edges, the same order as the engine's own stores.  The
+    remaining ceiling is :func:`check`, whose subgraph/SCC structures
+    are per-node Python lists over the ~P region; graphs are practical
+    to a few 10^7 states, beyond which the fair-lasso check (not this
+    export) needs its own array representation.
     """
     import dataclasses as _dc
 
@@ -340,16 +350,22 @@ def ddd_graph(config: CheckConfig, caps=None):
 
     kw = keystore.read(0, n).view(np.uint32)
     keys = keyset.pack_keys(kw[:, 1], kw[:, 0])
-    index = {int(k): i for i, k in enumerate(keys)}
+    # successor resolution by binary search over the sorted key array —
+    # no Python dict over n keys (ADVICE r3 #2: per-object overhead was
+    # the real export ceiling, ~hundreds of bytes/state)
+    order = np.argsort(keys)
+    sorted_keys = keys[order]
     expanded = constore.read(0, n)[:, 0].astype(bool)
     constore.close()
     keystore.close()
 
     step = jax.jit(kernels.build_step(bounds, cfg.spec, (),
                                       cfg.symmetry, view=cfg.view))
-    fam_of = [inst.family for inst in table]
-    edges: list = [[] for _ in range(n)]
-    enabled: list = [set() for _ in range(n)]
+    fams = sorted({inst.family for inst in table})
+    fam_idx = np.asarray([fams.index(inst.family) for inst in table],
+                         np.int32)
+    en_mat = np.zeros((n, len(fams)), bool)
+    e_u, e_a, e_v = [], [], []
     for c0 in range(0, n, B):
         nb = min(B, n - c0)
         vecs = schema.unpack(host.read(c0, nb), np)
@@ -361,14 +377,77 @@ def ddd_graph(config: CheckConfig, caps=None):
         skeys = keyset.pack_keys(
             np.asarray(out["fp_hi"])[:nb].reshape(nb, A),
             np.asarray(out["fp_lo"])[:nb].reshape(nb, A))
-        for b, a in zip(*np.nonzero(valid)):
-            u = c0 + int(b)
-            enabled[u].add(fam_of[a])
-            if expanded[u]:
-                edges[u].append((int(a), index[int(skeys[b, a])]))
+        b_idx, a_idx = np.nonzero(valid)
+        u_idx = (c0 + b_idx).astype(np.int64)
+        en_mat[u_idx, fam_idx[a_idx]] = True
+        m = expanded[u_idx]
+        ub, ab = u_idx[m], a_idx[m].astype(np.int32)
+        sk = skeys[b_idx[m], ab]
+        pos = np.searchsorted(sorted_keys, sk)
+        if not np.array_equal(sorted_keys[np.minimum(
+                pos, n - 1)], sk):
+            raise RuntimeError("ddd_graph: successor key missing from "
+                               "the key log — store corrupt")
+        e_u.append(ub)
+        e_a.append(ab)
+        e_v.append(order[pos].astype(np.int64))
+
+    u_all = np.concatenate(e_u) if e_u else np.zeros(0, np.int64)
+    a_all = np.concatenate(e_a) if e_a else np.zeros(0, np.int32)
+    v_all = np.concatenate(e_v) if e_v else np.zeros(0, np.int64)
+    # u_all is globally nondecreasing by construction (chunks ascend,
+    # np.nonzero is row-major), so CSR needs no sort — just verify
+    if u_all.size and (np.diff(u_all) < 0).any():
+        raise AssertionError("ddd_graph: edge sources out of order")
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(np.bincount(u_all, minlength=n), out=indptr[1:])
+    edges = _CSREdges(indptr, a_all, v_all)
+    enabled = _EnabledSets(en_mat, fams)
 
     states = StatesView(host, schema, lay, bounds, n)
-    return states, edges, enabled, [bool(x) for x in expanded]
+    return states, edges, enabled, expanded
+
+
+class _CSREdges:
+    """``edges[u] -> [(aidx, v), ...]`` materialized on demand from CSR
+    arrays — 12 B/edge flat storage instead of per-node Python lists of
+    tuple objects.  Supports exactly the access patterns
+    :func:`check` uses (indexing, ``len``, iteration)."""
+
+    def __init__(self, indptr, aidx, vidx):
+        self._indptr, self._aidx, self._vidx = indptr, aidx, vidx
+
+    @property
+    def n_edges(self) -> int:
+        return int(self._indptr[-1])
+
+    def __len__(self):
+        return len(self._indptr) - 1
+
+    def __getitem__(self, u):
+        if u < 0 or u >= len(self):
+            raise IndexError(u)
+        s, e = self._indptr[u], self._indptr[u + 1]
+        return list(zip(self._aidx[s:e].tolist(),
+                        self._vidx[s:e].tolist()))
+
+
+class _EnabledSets:
+    """``enabled[u] -> {family, ...}`` view over an ``[n, F]`` bool
+    matrix (one byte per (state, family) instead of a Python set per
+    state)."""
+
+    def __init__(self, mat, fams):
+        self._mat, self._fams = mat, fams
+
+    def __len__(self):
+        return self._mat.shape[0]
+
+    def __getitem__(self, u):
+        if u < 0 or u >= len(self):
+            raise IndexError(u)
+        row = self._mat[u]
+        return {f for f, b in zip(self._fams, row) if b}
 
 
 def _sccs(n: int, adj) -> list:
@@ -467,6 +546,9 @@ def check(config: CheckConfig, prop: str,
     states, edges, enabled, expanded = graph if graph is not None \
         else explore_graph(config)
     n = len(states)
+    # O(1) for CSR exports, O(n) list walk otherwise — never O(edges)
+    n_edges = edges.n_edges if hasattr(edges, "n_edges") \
+        else sum(map(len, edges))
     p_mask = states.mask(prop) if isinstance(states, StatesView) \
         else [pred(s, bounds) for s in states]
 
@@ -573,7 +655,7 @@ def check(config: CheckConfig, prop: str,
 
     if best is None:
         return LivenessResult(prop=prop, holds=True, violation=None,
-                              n_states=n, n_edges=sum(map(len, edges)),
+                              n_states=n, n_edges=n_edges,
                               n_sccs_checked=n_checked)
 
     nodes, wit, entry = best
@@ -609,5 +691,5 @@ def check(config: CheckConfig, prop: str,
         cycle = [("<stutter>", states[entry])]
     violation = LassoViolation(prop=prop, prefix=prefix, cycle=cycle)
     return LivenessResult(prop=prop, holds=False, violation=violation,
-                          n_states=n, n_edges=sum(map(len, edges)),
+                          n_states=n, n_edges=n_edges,
                           n_sccs_checked=n_checked)

@@ -613,6 +613,14 @@ def apply_stages(bounds, stages, symmetry, succs, svecs, valid):
     EP-routed step runs the same stages on its compacted ``[K]`` axis)."""
     lay, consts, _expand, inv_fns, orbit_fp, pallas_orbit_fp, viewer = \
         stages
+    # Under symmetry the viewed ksvecs is never repacked (the orbit path
+    # consumes ksuccs), so the Pallas orbit branch below would
+    # fingerprint UNVIEWED rows.  _step_stages never builds that
+    # combination; assert the invariant here so a drift at either site
+    # fails loudly instead of silently corrupting dedup keys.
+    if viewer is not None and pallas_orbit_fp is not None:
+        raise AssertionError(           # explicit: survives python -O
+            "pallas_orbit_fp cannot compose with a view (unviewed svecs)")
     ksuccs, ksvecs = succs, svecs          # dedup-key inputs
     if viewer is not None:
         ksuccs = jax.vmap(jax.vmap(viewer))(succs)

@@ -40,12 +40,11 @@ shard_engine.py (TLC's multi-worker mode shares it).
 
 Capacity: host RAM for keys + rows (as ddd_engine), device HBM holds
 only the per-shard lossy filter and transfer buffers — the composition
-runs/northstar_sizing.md calls for.  Like every engine in this repo the
-discovery-id space is int32 (parent links, trace ids): the loud
-FAIL_INDEX guard fires at ~2.13e9 states (_IDX_CEIL), so 10^9-scale
-spaces fit; the config-#4 10^10+ projection additionally needs the
-int64 id widening tracked in RESULTS.md "known gaps", not just more
-chips.
+runs/northstar_sizing.md calls for.  Discovery ids are int64 end-to-end
+since round 4 (C++ store links, width-3 checkpoint streams, host
+rebasing of window-relative device parents), so neither 10^9- nor
+10^10-scale spaces hit an id ceiling (VERDICT r3 missing #2 closed);
+the binding limits are host RAM and wall clock.
 
 Checkpoints reuse the single-chip DDD incremental stream format
 (.rows/.links/.con/.keys + npz); ``blocks_done`` counts completed
@@ -106,7 +105,7 @@ class DDDShardCapacities:
     ``send2``: stage-B depth on 2-D meshes (None = ``nici * send``)."""
 
     block: int = 1 << 18
-    table: int = 1 << 24
+    table: int = 1 << 22
     seg_rows: int = 1 << 19
     flush: int = 1 << 22
     levels: int = 1 << 12
@@ -162,7 +161,8 @@ class MBufs(NamedTuple):
     okey_hi: jax.Array    # [dev] [OCAP]
     okey_lo: jax.Array    # [dev]
     orows: jax.Array      # [dev] [OCAP, P]
-    opar: jax.Array       # [dev] [OCAP] parent GLOBAL discovery index
+    opar: jax.Array       # [dev] [OCAP] parent id, WINDOW-RELATIVE
+                          # (int32-safe at any depth; harvest adds wbase)
     olane: jax.Array      # [dev] [OCAP]
     ocon: jax.Array       # [dev] [OCAP]
 
@@ -493,12 +493,14 @@ class DDDShardEngine:
             for s in range(nd):
                 self._gbuf[s * Fcap:s * Fcap + wrows] = blk
                 self._gcon[s * Fcap:s * Fcap + wrows] = con
-            gpar = np.tile(wbase + np.arange(Fcap), nd).astype(np.int32)
+            # WINDOW-RELATIVE parent ids (fit int32 at any campaign
+            # depth); the harvest rebases by adding wbase as int64
+            gpar = np.tile(np.arange(Fcap), nd).astype(np.int32)
             nrows = np.full((nd,), wrows, np.int32)
         else:
             self._gbuf[:wrows] = host.read(wbase, wrows)
             self._gcon[:wrows] = constore.read(wbase, wrows)[:, 0]
-            gpar = (wbase + np.arange(nd * Fcap)).astype(np.int32)
+            gpar = np.arange(nd * Fcap, dtype=np.int32)  # window-relative
             nrows = np.clip(wrows - np.arange(nd) * Fcap, 0, Fcap) \
                 .astype(np.int32)
         sh = self._in_shardings
@@ -631,7 +633,7 @@ class DDDShardEngine:
                 resume, (hi0, lo0))
             if checkpoint and os.path.abspath(resume) == \
                     os.path.abspath(checkpoint):
-                for suf, w in ((".rows", self.schema.P), (".links", 2),
+                for suf, w in ((".rows", self.schema.P), (".links", 3),
                                (".con", 1), (".keys", 2)):
                     ckpt.trim_stream(checkpoint + suf, n_states, w)
         else:
@@ -644,7 +646,7 @@ class DDDShardEngine:
             masters[int(np.uint32(hi0) % np.uint32(self.ndev))].seed(k0)
             host.append(self.schema.pack(
                 np.asarray(init_vec, np.int32), np)[None, :])
-            host.append_links(np.asarray([-1], np.int32),
+            host.append_links(np.asarray([-1], np.int64),
                               np.asarray([-1], np.int32))
             constore.append(np.asarray(
                 [[interp.constraint_ok(init_py, bounds)]], np.int32))
@@ -683,7 +685,11 @@ class DDDShardEngine:
             on_progress({
                 "wall_s": round(wall, 3),
                 "n_states": n_states + sum(
-                    sum(len(k) for k in st_["keys"]) for st_ in staging),
+                    sum(len(k) for k in st_["keys"]) for st_ in staging)
+                + sum(sum(len(k) for k in p_["keys"]) for p_ in pend),
+                # staged counts are exact (post-dedup); pend is the raw
+                # harvested stream, so the sum is an upper bound — same
+                # contract as the single-chip engine's progress()
                 "level": len(level_ends),
                 "n_transitions": n_trans,
                 "n_devices": self.ndev,
@@ -744,8 +750,9 @@ class DDDShardEngine:
                             bufs_h.okey_lo[o:o + ns]))
                         pend[s]["rows"].append(
                             bufs_h.orows[o:o + ns].copy())
-                        pend[s]["par"].append(
-                            bufs_h.opar[o:o + ns].copy())
+                        pend[s]["par"].append(       # rebase to global
+                            bufs_h.opar[o:o + ns].astype(np.int64)
+                            + wbase)
                         pend[s]["lane"].append(
                             bufs_h.olane[o:o + ns].copy())
                         pend[s]["con"].append(
@@ -770,7 +777,7 @@ class DDDShardEngine:
                         continue
                     elif (dgs >= 0).any():
                         s = int(np.nonzero(dgs >= 0)[0][0])
-                        viol = (2, 0, int(dgs[s]))
+                        viol = (2, 0, int(dgs[s]) + wbase)
                         stopped = True
                         continue
                     now = time.monotonic()
@@ -948,8 +955,12 @@ def reshard_ddd_checkpoint(config: CheckConfig,
                                      if rows_done == lvl_rows
                                      else rows_done // w_dst)
     n_states = int(fields["n_states"])
+    # .links is width 3 post-int64-widening, width 2 in pre-round-4
+    # snapshots; the stream moves verbatim either way (the loader
+    # dual-reads both), so copy at the source's own width
+    links_w = ckpt.stream_width(src_path + ".links")
     for suf, w in ((".rows", bitpack.BitSchema(config.bounds).P),
-                   (".links", 2), (".con", 1), (".keys", 2)):
+                   (".links", links_w), (".con", 1), (".keys", 2)):
         ckpt.copy_stream(src_path + suf, dst_path + suf, n_states, w)
     ckpt.atomic_savez(
         dst_path, **fields,

@@ -150,6 +150,16 @@ def stream_rows_append(path: str, reader, end: int, width: int) -> None:
         os.fsync(f.fileno())
 
 
+def stream_width(path: str) -> int:
+    """Row width of an append-only stream (the one place that knows the
+    header layout outside the readers/writers in this module)."""
+    with open(path, "rb") as f:
+        hdr = np.fromfile(f, np.int64, 2)
+    if hdr.shape[0] != 2:
+        raise ValueError(f"stream {path}: truncated header")
+    return int(hdr[1])
+
+
 def trim_stream(path: str, n_rows: int, width: int) -> None:
     """Cap an append-only stream's trusted prefix at ``n_rows`` (resume
     hygiene: rows beyond the restored metadata's count came from a

@@ -78,10 +78,10 @@ def _load():
     lib.store_read.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                ctypes.c_int64, _i32p]
     lib.store_append_links.restype = ctypes.c_int64
-    lib.store_append_links.argtypes = [ctypes.c_void_p, _i32p, _i32p,
+    lib.store_append_links.argtypes = [ctypes.c_void_p, _i64p, _i32p,
                                        ctypes.c_int64]
     lib.store_read_links.argtypes = [ctypes.c_void_p, ctypes.c_int64,
-                                     ctypes.c_int64, _i32p, _i32p]
+                                     ctypes.c_int64, _i64p, _i32p]
     lib.store_trace_chain.restype = ctypes.c_int64
     lib.store_trace_chain.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                       _i64p, ctypes.c_int64]
@@ -97,6 +97,10 @@ HAS_NATIVE = _lib is not None
 
 def _as_i32(a: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(a, dtype=np.int32)
+
+
+def _as_i64(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int64)
 
 
 class HostStore:
@@ -127,10 +131,11 @@ class HostStore:
         return out
 
     def append_links(self, parent: np.ndarray, lane: np.ndarray) -> int:
-        parent, lane = _as_i32(parent).ravel(), _as_i32(lane).ravel()
+        # int64 parents: discovery indices outgrow int32 (VERDICT r3 #2)
+        parent, lane = _as_i64(parent).ravel(), _as_i32(lane).ravel()
         assert parent.shape == lane.shape
         self._n_links = _lib.store_append_links(
-            self._h, parent.ctypes.data_as(_i32p),
+            self._h, parent.ctypes.data_as(_i64p),
             lane.ctypes.data_as(_i32p), parent.shape[0])
         return self._n_links
 
@@ -138,10 +143,10 @@ class HostStore:
         if not (0 <= start and start + n <= self._n_links):
             raise IndexError(
                 f"read_links [{start}, {start + n}) of {self._n_links}")
-        parent = np.empty((n,), np.int32)
+        parent = np.empty((n,), np.int64)
         lane = np.empty((n,), np.int32)
         _lib.store_read_links(self._h, start, n,
-                              parent.ctypes.data_as(_i32p),
+                              parent.ctypes.data_as(_i64p),
                               lane.ctypes.data_as(_i32p))
         return parent, lane
 
@@ -227,7 +232,7 @@ class PyHostStore:
         return self._rows.read(start, n)
 
     def append_links(self, parent, lane) -> int:
-        self._parents.append(_as_i32(parent).ravel().copy())
+        self._parents.append(_as_i64(parent).ravel().copy())
         self._lanes.append(_as_i32(lane).ravel().copy())
         return len(self._parents)
 

@@ -36,7 +36,7 @@ CFG = CheckConfig(
     invariants=("NoTwoLeaders", "CommittedWithinLog"),
     symmetry=("Server",), chunk=4096)
 
-CAPS = DDDCapacities(block=1 << 20, table=1 << 28, seg_rows=1 << 19,
+CAPS = DDDCapacities(block=1 << 20, table=1 << 22, seg_rows=1 << 19,
                      flush=1 << 23, levels=1 << 12)
 
 

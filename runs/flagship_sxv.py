@@ -39,7 +39,9 @@ CFG = CheckConfig(
 def main():
     deadline = float(sys.argv[1]) if len(sys.argv) > 1 else 3000.0
     sf = open(os.path.join(RUNS, "flagship_sxv.stats"), "a", buffering=1)
-    eng = DDDEngine(CFG, DDDCapacities(block=1 << 20, table=1 << 25,
+    # table 2^22: the round-4 filter measurement (runs/filter_inengine
+    # .out) — larger tables only add per-chunk copy cost
+    eng = DDDEngine(CFG, DDDCapacities(block=1 << 20, table=1 << 22,
                                        flush=1 << 22, levels=128))
     t0 = time.time()
     r = eng.check(deadline_s=deadline,
