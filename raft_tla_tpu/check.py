@@ -530,7 +530,8 @@ def main(argv=None) -> int:
                                           config.invariants,
                                           parity_view=not b.history,
                                           symmetry=config.symmetry,
-                                          view=config.view)
+                                          view=config.view,
+                                          spec=config.spec)
         except (OSError, ValueError) as e:
             print(f"Error: {e}", file=sys.stderr)
             return EXIT_ERROR

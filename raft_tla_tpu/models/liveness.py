@@ -554,10 +554,11 @@ def check(config: CheckConfig, prop: str,
 
     # The candidate cycle region: ~P states; edges must stay inside it.
     allowed = [not p for p in p_mask]
-    sub = [[v for _a, v in edges[u] if allowed[v]] if allowed[u] else []
-           for u in range(n)]
+    # one edges[u] materialization per node (CSR exports rebuild the
+    # tuple list per access); sub derives from sub_labeled
     sub_labeled = [[(a, v) for a, v in edges[u] if allowed[v]]
                    if allowed[u] else [] for u in range(n)]
+    sub = [[v for _a, v in lst] for lst in sub_labeled]
 
     def fair_here(nodes: list) -> dict | None:
         """If a fair cycle exists through these nodes, witness per WF

@@ -146,19 +146,53 @@ DeadVotes(v) == [i \\in Server |-> IF state[i] = Candidate THEN v[i]
                                    ELSE {}]"""
 
 
+# The election sub-spec's Next (models/spec.SUBSETS["election"]), with
+# the reference Next's exact structure — the per-step allLogs history
+# update is the top-level conjunct (raft.tla:464-465), the disjuncts are
+# the subset of raft.tla:455-461 the checker's election action table
+# enumerates.  Receive stays unrestricted: with AppendEntries excluded
+# the bag only ever holds RequestVote traffic, so the reachable spaces
+# coincide.
+_ELECTION_NEXT = """\
+\\* The election-only sub-spec (BASELINE config #2): Timeout +
+\\* RequestVote + BecomeLeader + Receive, the same subset of the
+\\* raft.tla:454-463 disjuncts the checker's --spec election explores.
+ElectionNext ==
+    /\\ \\/ \\E i \\in Server : Timeout(i)
+       \\/ \\E i, j \\in Server : RequestVote(i, j)
+       \\/ \\E i \\in Server : BecomeLeader(i)
+       \\/ \\E m \\in DOMAIN messages : Receive(m)
+    /\\ allLogs' = allLogs \\cup {log[i] : i \\in Server}
+
+ElectionSpec == Init /\\ [][ElectionNext]_vars"""
+
+
+def _spec_parts(spec: str):
+    """(module text blocks, SPECIFICATION name) for a sub-spec twin."""
+    if spec in (None, "full"):
+        return [], "Spec"
+    if spec == "election":
+        return [_ELECTION_NEXT, ""], "ElectionSpec"
+    raise ValueError(
+        f"no TLA+ export for spec {spec!r} (replication starts from a "
+        "preset-leader Init the exporter does not emit)")
+
+
 def emit_module(bounds: Bounds, invariants: tuple,
                 parity_view: bool = True, symmetry: bool = False,
-                view: str | None = None) -> str:
+                view: str | None = None, spec: str = "full") -> str:
     """The ``MCraft.tla`` text: invariants + StateConstraint (+ VIEW)."""
     unknown = [nm for nm in invariants if nm not in _INVARIANT_TLA]
     if unknown:
         raise ValueError(f"no TLA+ export for invariants: {unknown}")
+    spec_blocks, _spec_name = _spec_parts(spec)
     parts = [f"---------------------------- MODULE {MODULE_NAME} "
              "----------------------------",
              "\\* Generated by raft_tla_tpu.models.tla_export — the TLC",
              "\\* oracle-side twin of one checker run. Extends the reference",
              "\\* spec unmodified.",
              "EXTENDS raft", ""]
+    parts += spec_blocks
     for nm in invariants:
         parts += [_INVARIANT_TLA[nm], ""]
     parts += [f"""\
@@ -201,12 +235,13 @@ DeadVotesView ==
 
 def emit_cfg(bounds: Bounds, invariants: tuple,
              parity_view: bool = True, symmetry: bool = False,
-             view: str | None = None) -> str:
+             view: str | None = None, spec: str = "full") -> str:
     """The ``MCraft.cfg`` text: reference bindings + the new stanzas."""
     servers = ", ".join(f"s{i + 1}" for i in range(bounds.n_servers))
     values = ", ".join(f"v{i + 1}" for i in range(bounds.n_values))
+    _blocks, spec_name = _spec_parts(spec)
     lines = [
-        "SPECIFICATION Spec",
+        f"SPECIFICATION {spec_name}",
         "",
         *[f"INVARIANT {nm}" for nm in invariants],
         "CONSTRAINT StateConstraint",
@@ -232,7 +267,7 @@ def emit_cfg(bounds: Bounds, invariants: tuple,
 
 def export(outdir: str, bounds: Bounds, invariants: tuple,
            parity_view: bool = True, symmetry: bool = False,
-           view: str | None = None) -> tuple:
+           view: str | None = None, spec: str = "full") -> tuple:
     """Write ``MCraft.tla``/``MCraft.cfg`` into ``outdir``; return the paths.
 
     Run on a host with a JVM as::
@@ -246,7 +281,8 @@ def export(outdir: str, bounds: Bounds, invariants: tuple,
     cfg = os.path.join(outdir, f"{MODULE_NAME}.cfg")
     with open(tla, "w", encoding="utf-8") as f:
         f.write(emit_module(bounds, invariants, parity_view, symmetry,
-                            view))
+                            view, spec))
     with open(cfg, "w", encoding="utf-8") as f:
-        f.write(emit_cfg(bounds, invariants, parity_view, symmetry, view))
+        f.write(emit_cfg(bounds, invariants, parity_view, symmetry, view,
+                         spec))
     return tla, cfg
