@@ -127,6 +127,14 @@ def build_argparser() -> argparse.ArgumentParser:
                         "source run when resuming or resharding — the "
                         "reshard summary prints the value to resume "
                         "with)")
+    p.add_argument("--retention", default="full",
+                   choices=("full", "frontier"),
+                   help="--engine ddd only: 'frontier' keeps master keys "
+                        "in RAM and only the current+next BFS level of "
+                        "rows in disk-backed level files, with NO trace "
+                        "links (violations report the state, not a path "
+                        "— TLC -noTrace).  ~16 B/state instead of ~76: "
+                        "the campaign mode for 10^9+-state spaces")
     p.add_argument("--cp-lanes", action="store_true",
                    help="--engine ddd-shard only: CP mode — shard the "
                         "bag-scan ACTION lanes across the mesh instead "
@@ -401,7 +409,8 @@ def _run(args, config):
             seg_rows = args.route
         eng = DDDEngine(config, DDDCapacities(
             block=args.block or 1 << 20, table=table, seg_rows=seg_rows,
-            levels=args.levels, route_rows=args.route))
+            levels=args.levels, route_rows=args.route,
+            retention=args.retention))
         return eng.check(on_progress=_stats_cb(args),
                          checkpoint=args.checkpoint,
                          checkpoint_every_s=args.checkpoint_every,

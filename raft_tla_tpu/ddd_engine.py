@@ -118,8 +118,21 @@ class DDDCapacities:
     flush: int = 1 << 23
     levels: int = 1 << 12
     route_rows: int = 0
+    # "full": every state row + trace link retained (traces, liveness
+    # exports, reshard).  "frontier": TLC's own campaign regime — RAM
+    # holds the master keys only, rows live in disk-backed current+next
+    # level files (utils/native.LevelStore), NO trace links (a
+    # violation reports the violating state, not a path — TLC -noTrace
+    # equivalence).  Lifts both the host-RAM (~76 B/state) and the
+    # checkpoint-disk (~68 B/state) ceilings to ~16 B/state, the
+    # difference between a ~1.5e9 and a ~7e9 state capacity on this
+    # host.  Retention is NOT checkpoint identity (the npz records the
+    # format; a full-format snapshot migrates on first frontier resume).
+    retention: str = "full"
 
     def __post_init__(self):
+        if self.retention not in ("full", "frontier"):
+            raise ValueError(f"retention={self.retention!r}")
         for nm in ("block", "table"):
             v = getattr(self, nm)
             if v & (v - 1):
@@ -280,6 +293,157 @@ def load_ddd_snapshot(path, P, digest):
                         expect_width=2)
     return (host, constore, keystore, n_states, n_trans, cov, level_ends,
             blocks_done)
+
+
+def save_frontier_snapshot(path, rows_ls, con_ls, keystore, n_states,
+                           n_trans, cov, level_ends, blocks_done,
+                           digest) -> None:
+    """Frontier-retention snapshots: the level files and the keys
+    stream ARE the store, so a snapshot is three syncs + the metadata
+    npz + post-commit cleanup of pre-frontier level files — no stream
+    copying at any state count."""
+    rows_ls.sync()
+    con_ls.sync()
+    keystore.sync()
+    ckpt.atomic_savez(
+        path,
+        n_states=np.int64(n_states),
+        n_trans=np.uint64(n_trans),
+        cov=np.asarray(cov, np.int64),
+        level_ends=np.asarray(level_ends, np.int64),
+        blocks_done=np.int64(blocks_done),
+        retention=np.bytes_(b"frontier"),
+        config_digest=np.uint64(digest))
+    rows_ls.delete_old()
+    con_ls.delete_old()
+
+
+def load_frontier_snapshot(path, P, digest):
+    """Open a frontier-format snapshot IN PLACE (no copying); also
+    migrates a full-format snapshot (no ``retention`` field in the
+    npz): the retained level window is sliced out of the old .rows/.con
+    streams into level files, the keys stream is renamed (formats
+    coincide), and the old full streams are REMOVED — a 983M-state
+    campaign checkpoint shrinks by the dead-prefix ~56 B/state."""
+    with ckpt.load_npz_checked(path, digest) as z:
+        n_states = int(z["n_states"])
+        n_trans = int(z["n_trans"])
+        cov = np.asarray(z["cov"], np.int64).copy()
+        level_ends = [int(x) for x in z["level_ends"]]
+        blocks_done = int(z["blocks_done"])
+        is_frontier = "retention" in z.files
+    L = len(level_ends)
+    lvl_lo = level_ends[-2] if L > 1 else 0
+    lvl_hi = level_ends[-1]
+    if not is_frontier:
+        _migrate_full_to_frontier(path, P, n_states, n_trans, cov,
+                                  level_ends, blocks_done, lvl_lo,
+                                  lvl_hi, L, digest)
+    else:
+        # idempotent leftover cleanup: a crash between the migration's
+        # npz commit and its stream deletions leaves full streams behind
+        for suf in (".rows", ".links", ".con"):
+            try:
+                os.remove(path + suf)
+            except FileNotFoundError:
+                pass
+    rows_ls = native.LevelStore(path + ".rows", P, L, lvl_lo, lvl_hi)
+    con_ls = native.LevelStore(path + ".con", 1, L, lvl_lo, lvl_hi)
+    keystore = native.FileStore(path + ".keys", 2, 0)
+    if len(keystore) < n_states:
+        raise ValueError(
+            f"key stream holds {len(keystore)} rows, metadata expects "
+            f"{n_states} — torn snapshot")
+    # a crash between keystore.sync() and the npz commit leaves the key
+    # stream LONGER than the metadata: truncate, or post-resume appends
+    # land past a stale gap and every key row misaligns from its state
+    keystore.trim(n_states)
+    rows_ls.trim_next(n_states)
+    con_ls.trim_next(n_states)
+    if len(rows_ls.cur) != lvl_hi or len(rows_ls) != n_states:
+        raise ValueError(
+            f"frontier level files hold [{rows_ls.cur.base}, "
+            f"{len(rows_ls.cur)}) + [{rows_ls.nxt.base}, {len(rows_ls)}),"
+            f" metadata expects [{lvl_lo}, {lvl_hi}) + {n_states} — "
+            "torn snapshot")
+    return (rows_ls, con_ls, keystore, n_states, n_trans, cov,
+            level_ends, blocks_done)
+
+
+def _migrate_full_to_frontier(path, P, n_states, n_trans, cov,
+                              level_ends, blocks_done, lvl_lo, lvl_hi,
+                              L, digest):
+    """One-way, one-time: slice the retained window out of a
+    full-format snapshot's streams into level files, verify the copies,
+    COMMIT a frontier-format metadata npz, and only then delete the
+    full .rows/.links/.con (the keys stream is format-identical and
+    stays).  Every crash window re-runs safely: before the npz commit
+    the old npz + full streams are intact (level files rewrite from
+    scratch); after it, the loader takes the frontier path and removes
+    stream leftovers idempotently."""
+    for prefix, width, reader_path in ((".rows", P, path + ".rows"),
+                                       (".con", 1, path + ".con")):
+        with open(reader_path, "rb") as f:
+            have, w = (int(x) for x in np.fromfile(f, np.int64, 2))
+            if w != width or have < n_states:
+                raise ValueError(
+                    f"{reader_path}: width {w} rows {have}, expected "
+                    f"width {width} >= {n_states} rows")
+
+            def slice_to(dst_path, base, end):
+                fs = native.FileStore(dst_path, width, base, reset=True)
+                step = 1 << 20
+                for s0 in range(base, end, step):
+                    n = min(step, end - s0)
+                    f.seek(16 + s0 * width * 4)
+                    fs.append(np.fromfile(f, np.int32, n * width)
+                              .reshape(n, width))
+                fs.sync()
+                fs.close()
+
+            slice_to(f"{path}{prefix}L{L}", lvl_lo, lvl_hi)
+            slice_to(f"{path}{prefix}L{L + 1}", lvl_hi, n_states)
+
+            # verify BEFORE the source streams are removed below — the
+            # full streams are the only copy of the campaign's history
+            rng = np.random.default_rng(0)
+            for dst, base, end in ((f"{path}{prefix}L{L}", lvl_lo,
+                                    lvl_hi),
+                                   (f"{path}{prefix}L{L + 1}", lvl_hi,
+                                    n_states)):
+                fs = native.FileStore(dst, width, base)
+                if len(fs) != end:
+                    raise RuntimeError(
+                        f"migration wrote {len(fs)} != {end} rows to "
+                        f"{dst} — full streams left untouched")
+                for s0 in ([base, max(base, end - 7)]
+                           + [int(x) for x in rng.integers(
+                               base, max(end - 7, base + 1), 8)]
+                           if end > base else []):
+                    n = min(7, end - s0)
+                    f.seek(16 + s0 * width * 4)
+                    want = np.fromfile(f, np.int32, n * width) \
+                        .reshape(n, width)
+                    if not np.array_equal(fs.read(s0, n), want):
+                        raise RuntimeError(
+                            f"migration verification mismatch at row "
+                            f"{s0} of {dst} — full streams left "
+                            "untouched")
+                fs.close()
+    ckpt.atomic_savez(
+        path,
+        n_states=np.int64(n_states),
+        n_trans=np.uint64(n_trans),
+        cov=np.asarray(cov, np.int64),
+        level_ends=np.asarray(level_ends, np.int64),
+        blocks_done=np.int64(blocks_done),
+        retention=np.bytes_(b"frontier"),
+        config_digest=np.uint64(digest))
+    for suf in (".rows", ".links", ".con"):
+        try:
+            os.remove(path + suf)
+        except FileNotFoundError:
+            pass
 
 
 # Per-call compacted-insert budget: only streamed keys reach the table
@@ -578,11 +742,12 @@ class DDDEngine:
         n_new = int(new_idx.size)
         if n_new:
             rows = np.concatenate(pend["rows"])[new_idx]
-            par = np.concatenate(pend["par"])[new_idx]
             lane = np.concatenate(pend["lane"])[new_idx]
             con = np.concatenate(pend["con"])[new_idx]
             host.append(rows)
-            host.append_links(par, lane)
+            if self.caps.retention == "full":
+                par = np.concatenate(pend["par"])[new_idx]
+                host.append_links(par, lane)
             constore.append(con.astype(np.int32)[:, None])
             nk = keys[new_idx]
             keystore.append(np.stack(
@@ -601,17 +766,24 @@ class DDDEngine:
                         blocks_done: int, init_key) -> None:
         """Block-boundary snapshots with an empty pending buffer; every
         stream (rows/links/constraints/keys) extends incrementally."""
-        save_ddd_snapshot(path, host, constore, keystore, n_states,
-                          n_trans, cov, level_ends, blocks_done,
-                          self.schema.P,
-                          ckpt.config_digest(self.config,
-                                             self._digest_caps, init_key))
+        digest = ckpt.config_digest(self.config, self._digest_caps,
+                                    init_key)
+        if self.caps.retention == "frontier":
+            save_frontier_snapshot(path, host, constore, keystore,
+                                   n_states, n_trans, cov, level_ends,
+                                   blocks_done, digest)
+        else:
+            save_ddd_snapshot(path, host, constore, keystore, n_states,
+                              n_trans, cov, level_ends, blocks_done,
+                              self.schema.P, digest)
 
     def load_checkpoint(self, path: str, init_key):
+        digest = ckpt.config_digest(self.config, self._digest_caps,
+                                    init_key)
+        load = load_frontier_snapshot \
+            if self.caps.retention == "frontier" else load_ddd_snapshot
         (host, constore, keystore, n_states, n_trans, cov, level_ends,
-         blocks_done) = load_ddd_snapshot(
-            path, self.schema.P,
-            ckpt.config_digest(self.config, self._digest_caps, init_key))
+         blocks_done) = load(path, self.schema.P, digest)
         kw = keystore.read(0, n_states).view(np.uint32)
         keys = keyset.pack_keys(kw[:, 1], kw[:, 0])
         master = keyset.MasterKeys(np.sort(keys))
@@ -648,42 +820,96 @@ class DDDEngine:
 
         B = self.config.chunk
         N = B * self.A
+        frontier = self.caps.retention == "frontier"
+        if frontier and retain_store:
+            raise ValueError(
+                "retain_store (liveness graph export) needs retention="
+                "'full' — frontier mode drops pre-frontier rows")
+        import contextlib
+        _cleanup = contextlib.ExitStack()
+        tmpdir = None
+        if frontier and resume and not checkpoint:
+            # frontier resumes in place: the level files ARE the store
+            checkpoint = resume
+        if frontier and not checkpoint:
+            # the level files need a home even without snapshots
+            import tempfile
+            tmpdir = tempfile.mkdtemp(prefix="ddd_frontier_",
+                                      dir=os.environ.get("TMPDIR", "."))
+            checkpoint_every_s = float("inf")
+            checkpoint = os.path.join(tmpdir, "run")
+
+            def _rm_tmpdir(d=tmpdir):
+                import shutil
+                shutil.rmtree(d, ignore_errors=True)
+            # runs on EVERY exit from check() incl. FAIL_*/KeyboardInterrupt
+            # (finding: level files for a 1e9-state run must not leak)
+            _cleanup.callback(_rm_tmpdir)
+        if frontier and resume and os.path.abspath(resume) != \
+                os.path.abspath(checkpoint):
+            # must precede load_checkpoint: the full->frontier migration
+            # inside it rewrites the RESUME path's files
+            raise ValueError(
+                "frontier mode resumes in place: --checkpoint must "
+                "equal --resume (the level files are the store)")
         # fresh run: any stream files at the checkpoint path belong to
         # some other run — remove before incremental appends trust them
         # (same contract as streamed_engine.check)
         _SUFFIXES = (".rows", ".links", ".con", ".keys")
         if checkpoint and not (resume and os.path.abspath(resume)
                                == os.path.abspath(checkpoint)):
+            import glob as _glob
             for suf in _SUFFIXES:
                 try:
                     os.remove(checkpoint + suf)
                 except FileNotFoundError:
                     pass
+            for pat in (".rowsL*", ".conL*"):
+                for pth in _glob.glob(checkpoint + pat):
+                    try:
+                        os.remove(pth)
+                    except OSError:
+                        pass
         if resume:
             (host, constore, keystore, master, n_states, n_trans, cov,
              level_ends, blocks_done) = self.load_checkpoint(
                 resume, (hi0, lo0))
             if checkpoint and os.path.abspath(resume) == \
-                    os.path.abspath(checkpoint):
+                    os.path.abspath(checkpoint) and not frontier:
                 for suf, w in ((".rows", self.schema.P), (".links", 3),
                                (".con", 1), (".keys", 2)):
                     # a pre-widening .links (width 2) is left alone: the
                     # first post-resume snapshot rewrites it whole
                     ckpt.trim_stream(checkpoint + suf, n_states, w)
         else:
-            host = native.make_store(self.schema.P)
-            constore = native.make_store(1)
-            keystore = native.make_store(2)
+            if frontier:
+                # level 1 = the init state alone; next level opens empty
+                host = native.LevelStore(checkpoint + ".rows",
+                                         self.schema.P, 1, 0, 1,
+                                         reset=True)
+                constore = native.LevelStore(checkpoint + ".con", 1, 1,
+                                             0, 1, reset=True)
+                keystore = native.FileStore(checkpoint + ".keys", 2, 0,
+                                            reset=True)
+            else:
+                host = native.make_store(self.schema.P)
+                constore = native.make_store(1)
+                keystore = native.make_store(2)
             master = keyset.MasterKeys()
             master.seed(int(keyset.pack_keys(
                 np.uint32(hi0)[None], np.uint32(lo0)[None])[0]))
             init_packed = self.schema.pack(
                 np.asarray(init_vec, np.int32), np)
-            host.append(init_packed[None, :])
-            host.append_links(np.asarray([-1], np.int64),
-                              np.asarray([-1], np.int32))
-            con0 = interp.constraint_ok(init_py, bounds)
-            constore.append(np.asarray([[con0]], np.int32))
+            if frontier:
+                host.cur.append(init_packed[None, :])
+                con0 = interp.constraint_ok(init_py, bounds)
+                constore.cur.append(np.asarray([[con0]], np.int32))
+            else:
+                host.append(init_packed[None, :])
+                host.append_links(np.asarray([-1], np.int64),
+                                  np.asarray([-1], np.int32))
+                con0 = interp.constraint_ok(init_py, bounds)
+                constore.append(np.asarray([[con0]], np.int32))
             keystore.append(np.asarray(
                 [[np.uint32(lo0), np.uint32(hi0)]],
                 np.uint32).view(np.int32))
@@ -806,10 +1032,13 @@ class DDDEngine:
                         pend["keys"].append(keyset.pack_keys(
                             bufs_h.okey_hi[:ns], bufs_h.okey_lo[:ns]))
                         pend["rows"].append(bufs_h.orows[:ns].copy())
-                        # rebase block-relative device parents to global
-                        # int64 discovery indices
-                        pend["par"].append(
-                            bufs_h.opar[:ns].astype(np.int64) + b_start)
+                        if not frontier:
+                            # rebase block-relative device parents to
+                            # global int64 discovery indices (frontier
+                            # mode keeps no links — skip the dead copy)
+                            pend["par"].append(
+                                bufs_h.opar[:ns].astype(np.int64)
+                                + b_start)
                         pend["lane"].append(bufs_h.olane[:ns].copy())
                         pend["con"].append(bufs_h.ocon[:ns].copy())
                     if vk or fail:
@@ -875,7 +1104,12 @@ class DDDEngine:
             if n_states == level_ends[-1]:       # no new states: done
                 break
             level_ends.append(n_states)
+            if frontier:
+                # the just-finished level's rows are dead weight now
+                host.rotate()
+                constore.rotate()
             if len(level_ends) > self.caps.levels:
+                _cleanup.close()
                 raise RuntimeError(
                     f"DDD search aborted: {decode_fail(FAIL_LEVEL)} "
                     f"(caps={self.caps}) — grow DDDCapacities and rerun")
@@ -883,6 +1117,7 @@ class DDDEngine:
         n_states += self._flush(pend, master, host, constore, keystore,
                                 cov)
         if fail:
+            _cleanup.close()
             raise RuntimeError(
                 f"DDD search aborted: {decode_fail(fail)} "
                 f"(caps={self.caps}) — grow DDDCapacities and rerun")
@@ -897,24 +1132,36 @@ class DDDEngine:
                 kw = keystore.read(viol_g, 1).view(np.uint32)
                 got_key = int(keyset.pack_keys(kw[:, 1], kw[:, 0])[0])
                 if got_key != int(viol_key):
+                    _cleanup.close()
                     raise RuntimeError(
                         "DDD violator identity mismatch after flush — "
                         "fingerprint collision or dedup-order bug")
             else:
                 viol_g = dead_g
                 inv_name = DEADLOCK
-            chain_idx = host.trace_chain(viol_g)
-            chain = []
-            for k, g in enumerate(chain_idx):
-                row = self.schema.unpack(host.read(int(g), 1)[0], np)
-                _, lane_g = host.read_links(int(g), 1)
+            if frontier:
+                # no trace links in frontier retention (TLC -noTrace
+                # equivalence): report the violating state itself — it
+                # is always within the retained level window
+                row = self.schema.unpack(host.read(int(viol_g), 1)[0],
+                                         np)
                 py = interp.from_struct(st.unpack(row, self.lay, np),
                                         self.bounds)
-                label = self.table[int(lane_g[0])].label() if k > 0 \
-                    else None
-                chain.append((label, py))
-            violation = Violation(invariant=inv_name, state=chain[-1][1],
-                                  trace=chain)
+                violation = Violation(invariant=inv_name, state=py,
+                                      trace=[(None, py)])
+            else:
+                chain_idx = host.trace_chain(viol_g)
+                chain = []
+                for k, g in enumerate(chain_idx):
+                    row = self.schema.unpack(host.read(int(g), 1)[0], np)
+                    _, lane_g = host.read_links(int(g), 1)
+                    py = interp.from_struct(st.unpack(row, self.lay, np),
+                                            self.bounds)
+                    label = self.table[int(lane_g[0])].label() if k > 0 \
+                        else None
+                    chain.append((label, py))
+                violation = Violation(invariant=inv_name,
+                                      state=chain[-1][1], trace=chain)
 
         levels_arr = [level_ends[0]] + [
             level_ends[k] - level_ends[k - 1]
@@ -923,6 +1170,10 @@ class DDDEngine:
         if tail > 0:                 # partial final level (stopped run)
             levels_arr.append(tail)
         coverage = aggregate_coverage(self.table, cov)
+        if tmpdir is not None:
+            host.close()
+            constore.close()
+            keystore.close()
         if retain_store:
             # graph exports (models/liveness.ddd_graph) re-expand the
             # stored rows; the caller owns closing these
@@ -931,6 +1182,7 @@ class DDDEngine:
             host.close()
             constore.close()
             keystore.close()
+        _cleanup.close()
         return EngineResult(
             n_states=n_states, diameter=len(levels_arr) - 1,
             n_transitions=n_trans, coverage=coverage,

@@ -256,6 +256,166 @@ def make_store(width: int):
     return HostStore(width) if HAS_NATIVE else PyHostStore(width)
 
 
+class FileStore:
+    """Append-only row store backed by a ckpt-format stream file — the
+    external-memory regime TLC's own ``states/`` directory uses
+    (reference ``.gitignore:2``): rows live on DISK, not host RAM, so a
+    campaign's state capacity is the filesystem, and the file IS the
+    checkpoint stream (``utils/ckpt`` header ``[n_rows, width]`` int64,
+    then raw int32 rows) — snapshotting costs an fsync, not a copy.
+
+    ``base``: global discovery index of the file's first row.  Reads
+    and appends address GLOBAL indices; rows below ``base`` don't exist
+    here (the frontier-retention engine mode drops pre-frontier levels
+    entirely).  The header's row count is committed by :meth:`sync` —
+    torn appends past the last sync are discarded on reopen, the same
+    crash contract as ckpt.stream_rows_append.
+    """
+
+    def __init__(self, path: str, width: int, base: int = 0,
+                 reset: bool = False):
+        self.path = path
+        self.width = int(width)
+        self.base = int(base)
+        mode = "w+b" if (reset or not os.path.exists(path)) else "r+b"
+        self._f = open(path, mode)
+        if mode == "w+b":
+            self._n = 0
+            self._write_header()
+        else:
+            hdr = np.fromfile(self._f, np.int64, 2)
+            if hdr.shape[0] != 2 or int(hdr[1]) != self.width:
+                raise ValueError(
+                    f"{path}: not a width-{self.width} row stream")
+            self._n = int(hdr[0])
+            # drop any torn tail beyond the committed header count
+            self._f.truncate(16 + self._n * self.width * 4)
+
+    def _write_header(self) -> None:
+        self._f.seek(0)
+        np.array([self._n, self.width], np.int64).tofile(self._f)
+
+    def __len__(self) -> int:
+        return self.base + self._n
+
+    def append(self, rows: np.ndarray) -> int:
+        rows = np.ascontiguousarray(rows, np.int32) \
+            .reshape(-1, self.width)
+        self._f.seek(16 + self._n * self.width * 4)
+        rows.tofile(self._f)
+        self._n += rows.shape[0]
+        return len(self)
+
+    def read(self, start: int, n: int) -> np.ndarray:
+        if not (self.base <= start and start + n <= len(self)):
+            raise IndexError(
+                f"read [{start}, {start + n}) of [{self.base}, "
+                f"{len(self)})")
+        self._f.seek(16 + (start - self.base) * self.width * 4)
+        out = np.fromfile(self._f, np.int32, n * self.width)
+        return out.reshape(n, self.width)
+
+    def sync(self) -> None:
+        """Commit appended rows: data flush, then header, then fsync."""
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._write_header()
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def trim(self, n_global: int) -> None:
+        """Drop committed rows past ``n_global`` (resume hygiene: rows
+        synced after the surviving metadata npz must be re-discovered,
+        not trusted)."""
+        n_local = n_global - self.base
+        if n_local < self._n:
+            self._n = n_local
+            self._f.truncate(16 + n_local * self.width * 4)
+            self._write_header()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class LevelStore:
+    """Current + next BFS level of rows, disk-backed (frontier
+    retention).  The level-synchronous engines only ever read the level
+    being expanded and append the one being discovered, so older
+    levels are dead weight in a no-trace campaign — exactly TLC's
+    memory regime (fingerprint set in RAM, states on disk,
+    ``/root/reference/.gitignore:2``).
+
+    Files are named ``{prefix}L{k}`` by BFS level index; ``rotate()``
+    at a level boundary makes the append target the new current level
+    and opens the next.  Files for levels older than current are
+    deleted only by :meth:`delete_old` (the checkpoint writer calls it
+    AFTER the metadata npz commits, so a crash mid-rotation still
+    resumes from the previous snapshot's files).
+    """
+
+    def __init__(self, prefix: str, width: int, cur_idx: int,
+                 cur_base: int, nxt_base: int, reset: bool = False):
+        self.prefix = prefix
+        self.width = int(width)
+        self.cur_idx = int(cur_idx)
+        self.cur = FileStore(f"{prefix}L{cur_idx}", width, cur_base,
+                             reset=reset)
+        self.nxt = FileStore(f"{prefix}L{cur_idx + 1}", width, nxt_base,
+                             reset=reset)
+
+    def __len__(self) -> int:
+        return len(self.nxt)
+
+    def append(self, rows: np.ndarray) -> int:
+        return self.nxt.append(rows)
+
+    def read(self, start: int, n: int) -> np.ndarray:
+        store = self.nxt if start >= self.nxt.base else self.cur
+        return store.read(start, n)
+
+    def rotate(self) -> None:
+        """Level boundary: next becomes current; open a fresh next."""
+        self.cur.close()
+        self.cur = self.nxt
+        self.cur_idx += 1
+        self.nxt = FileStore(f"{self.prefix}L{self.cur_idx + 1}",
+                             self.width, len(self.cur), reset=True)
+
+    def trim_next(self, n_global: int) -> None:
+        """Drop uncommitted next-level rows past the metadata count."""
+        self.nxt.trim(n_global)
+
+    def sync(self) -> None:
+        self.cur.sync()
+        self.nxt.sync()
+
+    def delete_old(self) -> None:
+        """Remove level files below the current index (post-npz-commit
+        cleanup; also reclaims files from superseded runs)."""
+        import glob
+        import re
+
+        for p in glob.glob(f"{self.prefix}L*"):
+            m = re.fullmatch(re.escape(self.prefix) + r"L(\d+)", p)
+            if m and int(m.group(1)) < self.cur_idx:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        self.cur.close()
+        self.nxt.close()
+
+
 def fingerprint_rows(rows: np.ndarray) -> tuple:
     """Bit-identical host fingerprint of packed rows via the C++ path.
 

@@ -36,8 +36,12 @@ CFG = CheckConfig(
     invariants=("NoTwoLeaders", "CommittedWithinLog"),
     symmetry=("Server",), chunk=4096)
 
+# retention="frontier" (round 4): master keys in RAM (8 B/orbit), rows
+# in disk-backed current+next level files, no trace links — the TLC
+# campaign regime.  Lifts the ~1.5e9 RAM/disk ceilings the full-
+# retention resume was dying under (73 GB RSS at 983M orbits) to ~7e9.
 CAPS = DDDCapacities(block=1 << 20, table=1 << 22, seg_rows=1 << 19,
-                     flush=1 << 23, levels=1 << 12)
+                     flush=1 << 23, levels=1 << 12, retention="frontier")
 
 
 def main():

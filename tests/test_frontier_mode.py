@@ -1,0 +1,131 @@
+"""Frontier-retention DDD mode (the TLC-regime campaign mode).
+
+Retention changes WHERE rows live (disk level files, no trace links),
+never WHAT is discovered: counts, levels, coverage and verdicts must
+be identical to full retention, checkpoints must resume in place, and
+a full-format snapshot must migrate on first frontier resume.
+"""
+
+import dataclasses
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+from raft_tla_tpu.models import refbfs
+
+ELECTION = CheckConfig(
+    bounds=Bounds(n_servers=2, n_values=1, max_term=2, max_log=0,
+                  max_msgs=2),
+    spec="election", invariants=("NoTwoLeaders",), chunk=256)
+
+FULL = CheckConfig(
+    bounds=Bounds(n_servers=2, n_values=2, max_term=2, max_log=1,
+                  max_msgs=2, max_dup=1),
+    spec="full",
+    invariants=("NoTwoLeaders", "LogMatching", "CommittedWithinLog"),
+    chunk=256)
+
+
+def _caps(**kw):
+    base = dict(block=1 << 12, table=1 << 10, seg_rows=1 << 15,
+                flush=1 << 12, levels=64, retention="frontier")
+    base.update(kw)
+    return DDDCapacities(**base)
+
+
+def assert_totals(got, ref):
+    assert got.n_states == ref.n_states
+    assert got.diameter == ref.diameter
+    assert got.n_transitions == ref.n_transitions
+    assert got.levels == ref.levels
+    assert got.coverage == ref.coverage
+
+
+def test_frontier_parity_election():
+    ref = refbfs.check(ELECTION)
+    got = DDDEngine(ELECTION, _caps()).check()
+    assert_totals(got, ref)
+    assert got.violation is None
+
+
+def test_frontier_parity_full_spec():
+    ref = refbfs.check(FULL)
+    got = DDDEngine(FULL, _caps()).check()
+    assert_totals(got, ref)
+
+
+def test_frontier_violation_reports_state_without_trace():
+    # 3 servers: a deposed leader coexists with a new-term leader (at 2
+    # servers quorum forces the step-down first, Naive is unreachable)
+    cfg = CheckConfig(
+        bounds=Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                      max_msgs=1),
+        spec="election", invariants=("NaiveNoTwoLeaders",), chunk=256)
+    ref = refbfs.check(cfg)
+    assert ref.violation is not None
+    got = DDDEngine(cfg, _caps()).check()
+    assert got.violation is not None
+    assert got.violation.invariant == "NaiveNoTwoLeaders"
+    # the same violating state the full-retention engine stops at;
+    # only the path is absent (TLC -noTrace equivalence)
+    full = DDDEngine(cfg, _caps(retention="full")).check()
+    assert got.violation.state == full.violation.state
+    assert len(got.violation.trace) == 1
+    assert got.n_states == full.n_states
+
+
+def test_frontier_deadlock():
+    cfg = CheckConfig(
+        bounds=Bounds(n_servers=1, n_values=1, max_term=2, max_log=0,
+                      max_msgs=2),
+        spec="election", invariants=(), check_deadlock=True, chunk=64)
+    ref = refbfs.check(cfg)
+    got = DDDEngine(cfg, _caps(block=1 << 8)).check()
+    assert got.violation is not None
+    assert got.violation.invariant == ref.violation.invariant
+    assert got.n_states == ref.n_states
+
+
+def test_frontier_checkpoint_resume(tmp_path):
+    ck = str(tmp_path / "f.ckpt")
+    ref = refbfs.check(FULL)
+    eng = DDDEngine(FULL, _caps())
+    part = eng.check(checkpoint=ck, checkpoint_every_s=0.0,
+                     deadline_s=1.0)
+    assert not part.complete
+    assert part.n_states < ref.n_states
+    assert os.path.exists(ck)       # at least one boundary snapshot
+    got = DDDEngine(FULL, _caps()).check(resume=ck, checkpoint=ck,
+                                         checkpoint_every_s=0.0)
+    assert_totals(got, ref)
+    # pre-frontier level files were cleaned at snapshots
+    idxs = sorted(int(p.rsplit("L", 1)[1])
+                  for p in glob.glob(ck + ".rowsL*"))
+    assert len(idxs) <= 3
+
+
+def test_full_snapshot_migrates_to_frontier(tmp_path):
+    """A full-format checkpoint (the elect5 campaign's situation)
+    resumes under retention='frontier': the retained window slices out
+    of the old streams, the dead prefix and .links are removed."""
+    ck = str(tmp_path / "m.ckpt")
+    ref = refbfs.check(FULL)
+    full_caps = _caps(retention="full")
+    part = DDDEngine(FULL, full_caps).check(
+        checkpoint=ck, checkpoint_every_s=0.0, deadline_s=1.0)
+    assert not part.complete
+    assert os.path.exists(ck + ".rows") and os.path.exists(ck + ".links")
+    got = DDDEngine(FULL, _caps()).check(resume=ck, checkpoint=ck,
+                                         checkpoint_every_s=0.0)
+    assert_totals(got, ref)
+    assert not os.path.exists(ck + ".rows")       # migrated + removed
+    assert not os.path.exists(ck + ".links")
+
+
+def test_frontier_rejects_retain_store():
+    with pytest.raises(ValueError, match="retain_store"):
+        DDDEngine(ELECTION, _caps()).check(retain_store=True)
