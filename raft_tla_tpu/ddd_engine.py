@@ -802,6 +802,18 @@ class DDDEngine:
               resume: str | None = None,
               deadline_s: float | None = None,
               retain_store: bool = False) -> EngineResult:
+        import contextlib
+        with contextlib.ExitStack() as stack:
+            # bound stack: tmpdir cleanup runs on EVERY exit, including
+            # KeyboardInterrupt and unexpected errors (review r4)
+            return self._check_impl(
+                init_override, on_progress, checkpoint,
+                checkpoint_every_s, resume, deadline_s, retain_store,
+                stack)
+
+    def _check_impl(self, init_override, on_progress, checkpoint,
+                    checkpoint_every_s, resume, deadline_s,
+                    retain_store, _cleanup) -> EngineResult:
         t0 = time.monotonic()
         bounds = self.bounds
         init_py = init_override if init_override is not None \
@@ -825,8 +837,6 @@ class DDDEngine:
             raise ValueError(
                 "retain_store (liveness graph export) needs retention="
                 "'full' — frontier mode drops pre-frontier rows")
-        import contextlib
-        _cleanup = contextlib.ExitStack()
         tmpdir = None
         if frontier and resume and not checkpoint:
             # frontier resumes in place: the level files ARE the store
@@ -1105,9 +1115,13 @@ class DDDEngine:
                 break
             level_ends.append(n_states)
             if frontier:
-                # the just-finished level's rows are dead weight now
-                host.rotate()
-                constore.rotate()
+                # the just-finished level's rows are dead weight now.
+                # With snapshots, the files outlive the rotation until
+                # the npz commits (save_frontier_snapshot.delete_old);
+                # without (tmpdir mode) there is nothing to resume, so
+                # delete immediately or every level accumulates.
+                host.rotate(delete_old=tmpdir is not None)
+                constore.rotate(delete_old=tmpdir is not None)
             if len(level_ends) > self.caps.levels:
                 _cleanup.close()
                 raise RuntimeError(

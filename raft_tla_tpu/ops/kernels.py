@@ -32,7 +32,6 @@ prefixes.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -551,24 +550,24 @@ def _step_stages(bounds: Bounds, spec: str, invariants: tuple,
     orbit_fp = sym.build_orbit_fp(bounds, symmetry, consts,
                                   "allLogs" in lay.shapes) \
         if symmetry else None
-    # VMEM-resident Pallas orbit kernel (ops/pallas_orbit.py): HBM reads
-    # each candidate once instead of once per group element.  Opt-in via
-    # RAFT_TLA_PALLAS_ORBIT=1 (bit-identical keys — tests/
-    # test_pallas_orbit.py — so checkpoints carry across the switch);
-    # covers Server-only parity mode without a view, else the scan path.
-    pallas_orbit_fp = None
-    if symmetry and not view \
-            and os.environ.get("RAFT_TLA_PALLAS_ORBIT", "0") == "1":
-        from raft_tla_tpu.ops import pallas_orbit
-        pallas_orbit_fp = pallas_orbit.build_orbit_fp(
-            bounds, symmetry, "allLogs" in lay.shapes)
+    # The lax.scan orbit pass above is the PERMANENT design (VERDICT r3
+    # next #9, decided round 4): a VMEM-resident Pallas orbit kernel was
+    # built in round 2, measured at speed parity (0.7-1.15x) where
+    # Mosaic compiled it (P <= 6 unrolled perms), failed Mosaic
+    # compilation at P=24 (kernel stack scales with the unrolled group;
+    # 73 MB at P=120 vs the 16 MB scoped-vmem limit, and the P=24
+    # remote-compile returned HTTP 500 — runs/pallas_orbit_p24.out),
+    # and was deleted: XLA's scan fusion already keeps one copy of the
+    # permute/canonicalize/pack/fingerprint pipeline resident, which is
+    # all the kernel could offer.  Mosaic findings preserved in
+    # RESULTS.md "Pallas orbit kernel" and runs/pallas_orbit_p24.out.
     # The view folds into the DEDUP KEY only: stored rows, invariants and
     # the constraint all see the full successor (TLC VIEW semantics).
     viewer = None
     if view:
         from raft_tla_tpu.models import views as views_mod
         viewer = views_mod.jnp_view(view, bounds)
-    return lay, consts, expand, inv_fns, orbit_fp, pallas_orbit_fp, viewer
+    return lay, consts, expand, inv_fns, orbit_fp, viewer
 
 
 def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
@@ -611,16 +610,7 @@ def apply_stages(bounds, stages, symmetry, succs, svecs, valid):
     view, orbit/plain fingerprints, invariants, StateConstraint.  One
     definition shared by the dense step and the CP-sharded step (the
     EP-routed step runs the same stages on its compacted ``[K]`` axis)."""
-    lay, consts, _expand, inv_fns, orbit_fp, pallas_orbit_fp, viewer = \
-        stages
-    # Under symmetry the viewed ksvecs is never repacked (the orbit path
-    # consumes ksuccs), so the Pallas orbit branch below would
-    # fingerprint UNVIEWED rows.  _step_stages never builds that
-    # combination; assert the invariant here so a drift at either site
-    # fails loudly instead of silently corrupting dedup keys.
-    if viewer is not None and pallas_orbit_fp is not None:
-        raise AssertionError(           # explicit: survives python -O
-            "pallas_orbit_fp cannot compose with a view (unviewed svecs)")
+    lay, consts, _expand, inv_fns, orbit_fp, viewer = stages
     ksuccs, ksvecs = succs, svecs          # dedup-key inputs
     if viewer is not None:
         ksuccs = jax.vmap(jax.vmap(viewer))(succs)
@@ -628,12 +618,9 @@ def apply_stages(bounds, stages, symmetry, succs, svecs, valid):
             ksvecs = jax.vmap(jax.vmap(
                 lambda t: st.pack(t, jnp)))(ksuccs)
     if symmetry:
-        if pallas_orbit_fp is not None:
-            fh, fl = pallas_orbit_fp(ksvecs.reshape(-1, lay.width))
-        else:
-            flat = jax.tree.map(
-                lambda a: a.reshape((-1,) + a.shape[2:]), ksuccs)
-            fh, fl = orbit_fp(flat)
+        flat = jax.tree.map(
+            lambda a: a.reshape((-1,) + a.shape[2:]), ksuccs)
+        fh, fl = orbit_fp(flat)
         fp_hi = fh.reshape(svecs.shape[:2])
         fp_lo = fl.reshape(svecs.shape[:2])
     else:
@@ -690,7 +677,7 @@ def build_step_routed(bounds: Bounds, spec: str = "full",
     default.  Correct for parity AND faithful mode (the expansion twin
     carries the allLogs update; history fields ride the same gather).
     """
-    (lay, consts, expand, inv_fns, orbit_fp, pallas_orbit_fp,
+    (lay, consts, expand, inv_fns, orbit_fp,
      viewer) = _step_stages(bounds, spec, invariants, symmetry, view)
     if k_rows <= 0:
         raise ValueError(f"k_rows={k_rows} must be positive")
@@ -725,10 +712,7 @@ def build_step_routed(bounds: Bounds, spec: str = "full",
             if not symmetry:
                 ksvecs = jax.vmap(lambda t: st.pack(t, jnp))(ksucc)
         if symmetry:
-            if pallas_orbit_fp is not None:
-                cfp_hi, cfp_lo = pallas_orbit_fp(ksvecs)
-            else:
-                cfp_hi, cfp_lo = orbit_fp(ksucc)
+            cfp_hi, cfp_lo = orbit_fp(ksucc)
         else:
             cfp_hi, cfp_lo = fpr.fingerprint(ksvecs, consts, jnp)
         if inv_fns:

@@ -381,9 +381,17 @@ class LevelStore:
         store = self.nxt if start >= self.nxt.base else self.cur
         return store.read(start, n)
 
-    def rotate(self) -> None:
-        """Level boundary: next becomes current; open a fresh next."""
+    def rotate(self, delete_old: bool = False) -> None:
+        """Level boundary: next becomes current; open a fresh next.
+        ``delete_old`` removes the finished level's file immediately —
+        only sound when no snapshot will ever resume from it."""
+        old_path = self.cur.path
         self.cur.close()
+        if delete_old:
+            try:
+                os.remove(old_path)
+            except OSError:
+                pass
         self.cur = self.nxt
         self.cur_idx += 1
         self.nxt = FileStore(f"{self.prefix}L{self.cur_idx + 1}",

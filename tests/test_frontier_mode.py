@@ -6,11 +6,9 @@ be identical to full retention, checkpoints must resume in place, and
 a full-format snapshot must migrate on first frontier resume.
 """
 
-import dataclasses
 import glob
 import os
 
-import numpy as np
 import pytest
 
 from raft_tla_tpu.config import Bounds, CheckConfig
