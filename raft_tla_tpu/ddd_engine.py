@@ -380,7 +380,19 @@ def _migrate_full_to_frontier(path, P, n_states, n_trans, cov,
     stays).  Every crash window re-runs safely: before the npz commit
     the old npz + full streams are intact (level files rewrite from
     scratch); after it, the loader takes the frontier path and removes
-    stream leftovers idempotently."""
+    stream leftovers idempotently.
+
+    ``.links`` is deleted FIRST: the frontier format never reads it,
+    and at campaign scale that frees the gigabytes the level-file
+    slices are about to need (the 983M-orbit checkpoint then migrates
+    within ~15 GB of transient headroom instead of ~22).  A crash after
+    that point only forecloses resuming this snapshot in FULL retention
+    (which the caller just chose to leave); frontier re-migration is
+    unaffected."""
+    try:
+        os.remove(path + ".links")
+    except FileNotFoundError:
+        pass
     for prefix, width, reader_path in ((".rows", P, path + ".rows"),
                                        (".con", 1, path + ".con")):
         with open(reader_path, "rb") as f:

@@ -93,6 +93,16 @@ class Capacities:
         return dataclasses.replace(self, n_states=self.n_states * 2)
 
 
+# Chip-measured note (round 4, runs/filter_inengine.out): inside a
+# while_loop body that both GATHERS from and SCATTERS to the same carry
+# table, XLA materializes a full defensive copy of the table every
+# iteration (~45 ns per byte of table) — in-place donation does not
+# apply.  For this EXACT table the size is a correctness requirement
+# (unlike the DDD engines' shrinkable lossy filter), so large --cap
+# runs pay ~45 ms/chunk per GiB of table; that copy, not the probe
+# gathers, is most of what the round-2 "paged engine at 2^28 slots
+# measured ~8k orbits/s" observation was.  The DDD engines are the
+# designed escape (host-exact dedup, small filter).
 def _dedup_insert(tbl_hi, tbl_lo, key_hi, key_lo, active):
     """Batched insert-if-absent of fingerprint pairs into the hash set.
 
