@@ -127,3 +127,44 @@ def test_full_snapshot_migrates_to_frontier(tmp_path):
 def test_frontier_rejects_retain_store():
     with pytest.raises(ValueError, match="retain_store"):
         DDDEngine(ELECTION, _caps()).check(retain_store=True)
+
+
+def test_filestore_torn_append_discarded(tmp_path):
+    """Rows appended after the last sync() are discarded on reopen —
+    the crash contract snapshots rely on."""
+    from raft_tla_tpu.utils import native
+
+    p = str(tmp_path / "s.stream")
+    fs = native.FileStore(p, 3, base=5, reset=True)
+    fs.append([[1, 2, 3], [4, 5, 6]])
+    fs.sync()                        # commits rows 5..6
+    fs.append([[7, 8, 9]])           # torn: never synced
+    fs._f.flush()                    # bytes on disk, header not updated
+    fs.close()
+
+    fs2 = native.FileStore(p, 3, base=5)
+    assert len(fs2) == 7             # base 5 + 2 committed rows
+    assert fs2.read(5, 2).tolist() == [[1, 2, 3], [4, 5, 6]]
+    # appends continue exactly at the committed point
+    fs2.append([[9, 9, 9]])
+    fs2.sync()
+    assert fs2.read(7, 1).tolist() == [[9, 9, 9]]
+    fs2.close()
+
+
+def test_levelstore_rotation_and_trim(tmp_path):
+    from raft_tla_tpu.utils import native
+
+    ls = native.LevelStore(str(tmp_path / "r"), 2, 1, 0, 1, reset=True)
+    ls.cur.append([[0, 0]])                  # the init row
+    ls.append([[1, 1], [2, 2]])              # level 2 discoveries
+    ls.sync()
+    ls.rotate()                              # level boundary
+    assert ls.cur.base == 1 and len(ls.cur) == 3
+    assert ls.nxt.base == 3
+    ls.append([[3, 3], [4, 4]])
+    ls.trim_next(4)                          # npz said only 4 states
+    assert len(ls) == 4
+    assert ls.read(3, 1).tolist() == [[3, 3]]
+    assert ls.read(1, 2).tolist() == [[1, 1], [2, 2]]   # cur routing
+    ls.close()
