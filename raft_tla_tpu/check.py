@@ -129,7 +129,8 @@ def build_argparser() -> argparse.ArgumentParser:
                         "with)")
     p.add_argument("--retention", default="full",
                    choices=("full", "frontier"),
-                   help="--engine ddd only: 'frontier' keeps master keys "
+                   help="--engine ddd / ddd-shard: 'frontier' keeps "
+                        "master keys "
                         "in RAM and only the current+next BFS level of "
                         "rows in disk-backed level files, with NO trace "
                         "links (violations report the state, not a path "
@@ -431,7 +432,8 @@ def _run(args, config):
         blk = args.block or _ddd_shard_block(args.chunk)
         eng = DDDShardEngine(config, mesh, DDDShardCapacities(
             block=blk, table=table, seg_rows=seg_rows,
-            levels=args.levels, cp=args.cp_lanes))
+            levels=args.levels, cp=args.cp_lanes,
+            retention=args.retention))
         return eng.check(on_progress=_stats_cb(args),
                          checkpoint=args.checkpoint,
                          checkpoint_every_s=args.checkpoint_every,

@@ -458,6 +458,35 @@ def _migrate_full_to_frontier(path, P, n_states, n_trans, cov,
             pass
 
 
+def frontier_checkpoint_setup(resume, checkpoint, checkpoint_every_s,
+                              cleanup, prefix):
+    """The frontier checkpoint-path contract, ONE definition for both
+    DDD engines (single-chip + mesh): in-place resume mapping, tmpdir
+    creation with cleanup registered on the caller's ExitStack, and the
+    resume==checkpoint requirement — which must be enforced BEFORE
+    load_checkpoint because the full->frontier migration rewrites the
+    RESUME path's files.  Returns (checkpoint, checkpoint_every_s,
+    tmpdir); ``tmpdir is not None`` is the ONLY sound gate for deleting
+    level files at rotation (nothing can resume a tmpdir run)."""
+    tmpdir = None
+    if resume and not checkpoint:
+        checkpoint = resume              # frontier resumes in place
+    if not checkpoint:
+        import shutil
+        import tempfile
+        tmpdir = tempfile.mkdtemp(prefix=prefix,
+                                  dir=os.environ.get("TMPDIR", "."))
+        cleanup.callback(
+            lambda d=tmpdir: shutil.rmtree(d, ignore_errors=True))
+        checkpoint_every_s = float("inf")
+        checkpoint = os.path.join(tmpdir, "run")
+    if resume and os.path.abspath(resume) != os.path.abspath(checkpoint):
+        raise ValueError(
+            "frontier mode resumes in place: --checkpoint must equal "
+            "--resume (the level files are the store)")
+    return checkpoint, checkpoint_every_s, tmpdir
+
+
 # Per-call compacted-insert budget: only streamed keys reach the table
 # scatter (typically a few thousand of the N=chunk*A candidates — 3.7k
 # at flagship shapes, runs/filter_anatomy.out), and a chunk streaming

@@ -75,7 +75,9 @@ from raft_tla_tpu.device_engine import (
     _EMPTY, BUCKET, FAIL_INDEX, FAIL_LEVEL, FAIL_ROUTE, FAIL_WIDTH,
     aggregate_coverage, decode_fail)
 from raft_tla_tpu.ddd_engine import (
-    _filter_insert, _IDX_CEIL, load_ddd_snapshot, save_ddd_snapshot)
+    _filter_insert, _IDX_CEIL, frontier_checkpoint_setup,
+    load_ddd_snapshot, load_frontier_snapshot, save_ddd_snapshot,
+    save_frontier_snapshot)
 from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import bitpack
@@ -111,6 +113,12 @@ class DDDShardCapacities:
     levels: int = 1 << 12
     send: Optional[int] = None
     send2: Optional[int] = None
+    # "frontier": the single-chip campaign regime on the mesh — master
+    # keys in RAM, rows/constraints in disk-backed current+next level
+    # files, no trace links (ddd_engine.DDDCapacities.retention docs).
+    # Shares the frontier snapshot format and migration with the
+    # single-chip engine.
+    retention: str = "full"
     # CP mode (SURVEY §2.9 CP row): every shard expands the SAME window
     # rows over its lane slice (parallel/cp_expand) instead of its own
     # row slice over all lanes — the bag-scan axis shards, the frontier
@@ -121,6 +129,8 @@ class DDDShardCapacities:
     cp: bool = False
 
     def __post_init__(self):
+        if self.retention not in ("full", "frontier"):
+            raise ValueError(f"retention={self.retention!r}")
         # table is bitmask-addressed (power of two); block is only window
         # arithmetic and just needs to be chunk-aligned (engine-checked)
         if self.table & (self.table - 1):
@@ -524,7 +534,9 @@ class DDDShardEngine:
         n_new = int(new_idx.size)
         if n_new:
             staging[s]["keys"].append(keys[new_idx])
-            for f in ("rows", "par", "lane", "con"):
+            fields = ("rows", "lane", "con") if not pend[s]["par"] \
+                else ("rows", "par", "lane", "con")
+            for f in fields:
                 staging[s][f].append(np.concatenate(pend[s][f])[new_idx])
         for lst in pend[s].values():
             lst.clear()
@@ -540,11 +552,12 @@ class DDDShardEngine:
                 continue
             keys = np.concatenate(staging[s]["keys"])
             rows = np.concatenate(staging[s]["rows"])
-            par = np.concatenate(staging[s]["par"])
             lane = np.concatenate(staging[s]["lane"])
             con = np.concatenate(staging[s]["con"])
             host.append(rows)
-            host.append_links(par, lane)
+            if self.caps.retention == "full":
+                par = np.concatenate(staging[s]["par"])
+                host.append_links(par, lane)
             constore.append(con.astype(np.int32)[:, None])
             keystore.append(np.stack(
                 [(keys & np.uint64(0xFFFFFFFF)).astype(np.uint32),
@@ -563,17 +576,24 @@ class DDDShardEngine:
                         init_key) -> None:
         """Window-boundary snapshots (pending + staging empty); the
         shared ddd_engine snapshot format — see reshard_ddd_checkpoint."""
-        save_ddd_snapshot(path, host, constore, keystore, n_states,
-                          n_trans, cov, level_ends, blocks_done,
-                          self.schema.P,
-                          ckpt.config_digest(self.config,
-                                             self._digest_caps, init_key))
+        digest = ckpt.config_digest(self.config, self._digest_caps,
+                                    init_key)
+        if self.caps.retention == "frontier":
+            save_frontier_snapshot(path, host, constore, keystore,
+                                   n_states, n_trans, cov, level_ends,
+                                   blocks_done, digest)
+        else:
+            save_ddd_snapshot(path, host, constore, keystore, n_states,
+                              n_trans, cov, level_ends, blocks_done,
+                              self.schema.P, digest)
 
     def load_checkpoint(self, path, init_key):
+        digest = ckpt.config_digest(self.config, self._digest_caps,
+                                    init_key)
+        load = load_frontier_snapshot \
+            if self.caps.retention == "frontier" else load_ddd_snapshot
         (host, constore, keystore, n_states, n_trans, cov, level_ends,
-         blocks_done) = load_ddd_snapshot(
-            path, self.schema.P,
-            ckpt.config_digest(self.config, self._digest_caps, init_key))
+         blocks_done) = load(path, self.schema.P, digest)
         masters = self._rebuild_masters(keystore, n_states)
         return (host, constore, keystore, masters, n_states, n_trans,
                 cov, level_ends, blocks_done)
@@ -603,6 +623,14 @@ class DDDShardEngine:
               on_progress=None, checkpoint: str | None = None,
               checkpoint_every_s: float = 600.0,
               resume: str | None = None) -> EngineResult:
+        import contextlib
+        with contextlib.ExitStack() as stack:
+            return self._check_impl(init_override, on_progress,
+                                    checkpoint, checkpoint_every_s,
+                                    resume, stack)
+
+    def _check_impl(self, init_override, on_progress, checkpoint,
+                    checkpoint_every_s, resume, _cleanup) -> EngineResult:
         t0 = time.monotonic()
         bounds = self.bounds
         init_py = init_override if init_override is not None \
@@ -619,37 +647,66 @@ class DDDShardEngine:
                     violation=Violation(nm, init_py, [(None, init_py)]),
                     levels=[1], wall_s=time.monotonic() - t0)
 
+        frontier = self.caps.retention == "frontier"
+        tmpdir = None
+        if frontier:
+            checkpoint, checkpoint_every_s, tmpdir = \
+                frontier_checkpoint_setup(resume, checkpoint,
+                                          checkpoint_every_s, _cleanup,
+                                          "dddshard_frontier_")
         _SUFFIXES = (".rows", ".links", ".con", ".keys")
         if checkpoint and not (resume and os.path.abspath(resume)
                                == os.path.abspath(checkpoint)):
+            import glob as _glob
             for suf in _SUFFIXES:
                 try:
                     os.remove(checkpoint + suf)
                 except FileNotFoundError:
                     pass
+            for pat in (".rowsL*", ".conL*"):
+                for pth in _glob.glob(checkpoint + pat):
+                    try:
+                        os.remove(pth)
+                    except OSError:
+                        pass
         if resume:
             (host, constore, keystore, masters, n_states, n_trans, cov,
              level_ends, blocks_done) = self.load_checkpoint(
                 resume, (hi0, lo0))
             if checkpoint and os.path.abspath(resume) == \
-                    os.path.abspath(checkpoint):
+                    os.path.abspath(checkpoint) and not frontier:
                 for suf, w in ((".rows", self.schema.P), (".links", 3),
                                (".con", 1), (".keys", 2)):
                     ckpt.trim_stream(checkpoint + suf, n_states, w)
         else:
-            host = native.make_store(self.schema.P)
-            constore = native.make_store(1)
-            keystore = native.make_store(2)
+            if frontier:
+                host = native.LevelStore(checkpoint + ".rows",
+                                         self.schema.P, 1, 0, 1,
+                                         reset=True)
+                constore = native.LevelStore(checkpoint + ".con", 1, 1,
+                                             0, 1, reset=True)
+                keystore = native.FileStore(checkpoint + ".keys", 2, 0,
+                                            reset=True)
+            else:
+                host = native.make_store(self.schema.P)
+                constore = native.make_store(1)
+                keystore = native.make_store(2)
             masters = [keyset.MasterKeys() for _ in range(self.ndev)]
             k0 = int(keyset.pack_keys(np.uint32(hi0)[None],
                                       np.uint32(lo0)[None])[0])
             masters[int(np.uint32(hi0) % np.uint32(self.ndev))].seed(k0)
-            host.append(self.schema.pack(
-                np.asarray(init_vec, np.int32), np)[None, :])
-            host.append_links(np.asarray([-1], np.int64),
-                              np.asarray([-1], np.int32))
-            constore.append(np.asarray(
-                [[interp.constraint_ok(init_py, bounds)]], np.int32))
+            init_row = self.schema.pack(
+                np.asarray(init_vec, np.int32), np)[None, :]
+            con_row = np.asarray(
+                [[interp.constraint_ok(init_py, bounds)]], np.int32)
+            if frontier:
+                host.cur.append(init_row)
+                constore.cur.append(con_row)
+            else:
+                host.append(init_row)
+                host.append_links(np.asarray([-1], np.int64),
+                                  np.asarray([-1], np.int32))
+                constore.append(con_row)
             keystore.append(np.asarray(
                 [[np.uint32(lo0), np.uint32(hi0)]],
                 np.uint32).view(np.int32))
@@ -750,9 +807,10 @@ class DDDShardEngine:
                             bufs_h.okey_lo[o:o + ns]))
                         pend[s]["rows"].append(
                             bufs_h.orows[o:o + ns].copy())
-                        pend[s]["par"].append(       # rebase to global
-                            bufs_h.opar[o:o + ns].astype(np.int64)
-                            + wbase)
+                        if not frontier:
+                            pend[s]["par"].append(   # rebase to global
+                                bufs_h.opar[o:o + ns].astype(np.int64)
+                                + wbase)
                         pend[s]["lane"].append(
                             bufs_h.olane[o:o + ns].copy())
                         pend[s]["con"].append(
@@ -828,6 +886,12 @@ class DDDShardEngine:
             if n_states == level_ends[-1]:       # no new states: done
                 break
             level_ends.append(n_states)
+            if self.caps.retention == "frontier":
+                # finished level's rows are dead weight (snapshots keep
+                # files alive until their npz commits; tmpdir runs have
+                # nothing to resume — delete immediately)
+                host.rotate(delete_old=tmpdir is not None)
+                constore.rotate(delete_old=tmpdir is not None)
             progress()
             if len(level_ends) > self.caps.levels:
                 raise RuntimeError(
@@ -866,18 +930,27 @@ class DDDShardEngine:
             else:
                 viol_g = ref
                 inv_name = DEADLOCK
-            chain_idx = host.trace_chain(viol_g)
-            chain = []
-            for k, g in enumerate(chain_idx):
-                row = self.schema.unpack(host.read(int(g), 1)[0], np)
-                _, lane_g = host.read_links(int(g), 1)
+            if self.caps.retention == "frontier":
+                # no trace links (TLC -noTrace): report the state
+                row = self.schema.unpack(host.read(int(viol_g), 1)[0],
+                                         np)
                 py = interp.from_struct(st.unpack(row, self.lay, np),
                                         self.bounds)
-                label = self.table[int(lane_g[0])].label() if k > 0 \
-                    else None
-                chain.append((label, py))
-            violation = Violation(invariant=inv_name, state=chain[-1][1],
-                                  trace=chain)
+                violation = Violation(invariant=inv_name, state=py,
+                                      trace=[(None, py)])
+            else:
+                chain_idx = host.trace_chain(viol_g)
+                chain = []
+                for k, g in enumerate(chain_idx):
+                    row = self.schema.unpack(host.read(int(g), 1)[0], np)
+                    _, lane_g = host.read_links(int(g), 1)
+                    py = interp.from_struct(st.unpack(row, self.lay, np),
+                                            self.bounds)
+                    label = self.table[int(lane_g[0])].label() if k > 0 \
+                        else None
+                    chain.append((label, py))
+                violation = Violation(invariant=inv_name,
+                                      state=chain[-1][1], trace=chain)
 
         levels_arr = [level_ends[0]] + [
             level_ends[k] - level_ends[k - 1]
@@ -936,6 +1009,7 @@ def reshard_ddd_checkpoint(config: CheckConfig,
         fields = {k: np.asarray(z[k]).copy() for k in
                   ("n_states", "n_trans", "cov", "level_ends",
                    "blocks_done")}
+        is_frontier = "retention" in z.files
     rows_done = int(fields["blocks_done"]) * (
         caps_src.block if caps_src.cp else ndev_src * caps_src.block)
     w_dst = caps_dst.block if caps_dst.cp else ndev_dst * caps_dst.block
@@ -955,15 +1029,33 @@ def reshard_ddd_checkpoint(config: CheckConfig,
                                      if rows_done == lvl_rows
                                      else rows_done // w_dst)
     n_states = int(fields["n_states"])
-    # .links is width 3 post-int64-widening, width 2 in pre-round-4
-    # snapshots; the stream moves verbatim either way (the loader
-    # dual-reads both), so copy at the source's own width
-    links_w = ckpt.stream_width(src_path + ".links")
-    for suf, w in ((".rows", bitpack.BitSchema(config.bounds).P),
-                   (".links", links_w), (".con", 1), (".keys", 2)):
-        ckpt.copy_stream(src_path + suf, dst_path + suf, n_states, w)
+    P_ = bitpack.BitSchema(config.bounds).P
+    if is_frontier:
+        # frontier snapshots: keys + the two live level files move
+        # verbatim (they are mesh-independent history, same as the full
+        # streams); links don't exist
+        le = [int(x) for x in fields["level_ends"]]
+        L = len(le)
+        lvl_lo = le[-2] if L > 1 else 0
+        ckpt.copy_stream(src_path + ".keys", dst_path + ".keys",
+                         n_states, 2)
+        for prefix, w in ((".rows", P_), (".con", 1)):
+            for idx, base, end in ((L, lvl_lo, le[-1]),
+                                   (L + 1, le[-1], n_states)):
+                ckpt.copy_stream(f"{src_path}{prefix}L{idx}",
+                                 f"{dst_path}{prefix}L{idx}",
+                                 end - base, w)
+    else:
+        # .links is width 3 post-int64-widening, width 2 in pre-round-4
+        # snapshots; the stream moves verbatim either way (the loader
+        # dual-reads both), so copy at the source's own width
+        links_w = ckpt.stream_width(src_path + ".links")
+        for suf, w in ((".rows", P_),
+                       (".links", links_w), (".con", 1), (".keys", 2)):
+            ckpt.copy_stream(src_path + suf, dst_path + suf, n_states, w)
+    extra = {"retention": np.bytes_(b"frontier")} if is_frontier else {}
     ckpt.atomic_savez(
-        dst_path, **fields,
+        dst_path, **fields, **extra,
         config_digest=np.uint64(ckpt.config_digest(
             config, _DigestCaps(block=caps_dst.block,
                                 levels=caps_dst.levels, ndev=ndev_dst,

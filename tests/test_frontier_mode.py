@@ -168,3 +168,53 @@ def test_levelstore_rotation_and_trim(tmp_path):
     assert ls.read(3, 1).tolist() == [[3, 3]]
     assert ls.read(1, 2).tolist() == [[1, 1], [2, 2]]   # cur routing
     ls.close()
+
+
+# -- mesh (ddd-shard) frontier mode ----------------------------------------
+
+def _mesh_caps(**kw):
+    from raft_tla_tpu.parallel.ddd_shard_engine import DDDShardCapacities
+
+    base = dict(block=256, table=1 << 10, seg_rows=1 << 16,
+                flush=1 << 10, levels=64, retention="frontier")
+    base.update(kw)
+    return DDDShardCapacities(**base)
+
+
+def test_mesh_frontier_parity_8dev():
+    from raft_tla_tpu.parallel.ddd_shard_engine import DDDShardEngine
+    from raft_tla_tpu.parallel.shard_engine import make_mesh
+
+    ref = refbfs.check(ELECTION)
+    got = DDDShardEngine(ELECTION, make_mesh(8), _mesh_caps()).check()
+    assert got.n_states == ref.n_states
+    assert got.diameter == ref.diameter
+    assert got.n_transitions == ref.n_transitions
+    assert got.levels == ref.levels
+
+
+def test_mesh_frontier_checkpoint_resume_and_reshard(tmp_path):
+    """Mesh frontier: snapshot, resume in place, and reshard the
+    frontier snapshot 8 -> 2 (keys + level files move verbatim)."""
+    from raft_tla_tpu.parallel.ddd_shard_engine import (
+        DDDShardEngine, reshard_ddd_checkpoint)
+    from raft_tla_tpu.parallel.shard_engine import make_mesh
+
+    ck = str(tmp_path / "m.ckpt")
+    ck2 = str(tmp_path / "m2.ckpt")
+    ref = refbfs.check(FULL)
+    DDDShardEngine(FULL, make_mesh(8), _mesh_caps()).check(
+        checkpoint=ck, checkpoint_every_s=0.0)
+    got = DDDShardEngine(FULL, make_mesh(8), _mesh_caps()).check(
+        resume=ck)
+    assert got.n_states == ref.n_states
+    assert got.diameter == ref.diameter
+
+    caps2 = _mesh_caps(block=1024, seg_rows=1 << 16)
+    reshard_ddd_checkpoint(FULL, _mesh_caps(), ck, ck2, ndev_src=8,
+                           ndev_dst=2, caps_dst=caps2)
+    from raft_tla_tpu.parallel.shard_engine import make_mesh as mm
+    got2 = DDDShardEngine(FULL, mm(2), caps2).check(resume=ck2)
+    assert got2.n_states == ref.n_states
+    assert got2.diameter == ref.diameter
+    assert got2.n_transitions == ref.n_transitions
