@@ -1,0 +1,240 @@
+"""VERDICT r3 item #5 (the measurement-protocol gate): decompose
+ddd_engine._filter_insert on the real chip and name the cause of the
+round-3 synthetic-vs-real ~1000x microbenchmark anomaly before any
+round-4 kernel number is trusted.
+
+Timed variants, each on BOTH real step outputs and synthetic random
+keys (the two input families whose disagreement is the anomaly):
+
+- ``full_nd``       — the r3 ablation's measurement: standalone jit, NO
+                      donation (XLA copies the 2x256 MB table per call).
+- ``full_chain``    — donated jit called K times with the table threaded
+                      through (the dispatch-level in-place pattern).
+- ``full_loop``     — a jitted fori_loop with the table as loop carry:
+                      the EXACT in-engine shape (_build_segment inlines
+                      _filter_insert into a while_loop body).
+- ``sort_only``     — the lexsort + first-of-key pass.
+- ``probe_only``    — the bucket gather + seen reduction.
+- ``insert_only``   — the two at[].set scatters (donated, loop carry).
+- ``copy_only``     — tbl + 0 (the non-donated copy's floor).
+
+Per-rep times come from diffing consecutive block_until_ready stamps
+over REPS reps (the r3 harness's average-of-asynchronous-dispatches is
+kept for comparison as *_async).
+
+Writes JSON lines to stdout; run on the real chip (no args).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.ddd_engine import _filter_insert
+from raft_tla_tpu.device_engine import _EMPTY, BUCKET
+from raft_tla_tpu.models import spec as S
+from raft_tla_tpu.ops import kernels
+
+from filter_ablation import CFG, TABLE, frontier_rows
+
+I32 = jnp.int32
+U32 = jnp.uint32
+REPS = 20
+CHAIN = 10
+
+
+def timed_sync(fn, *args, reps=REPS):
+    """Warm once, then time each rep to completion (no async pileup)."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def timed_async(fn, *args, reps=REPS):
+    """The r3 harness: dispatch reps asynchronously, block once."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def sort_only(key_hi, key_lo, active):
+    BA = key_hi.shape[0]
+    skh = jnp.where(active, key_hi, _EMPTY)
+    skl = jnp.where(active, key_lo, _EMPTY)
+    perm = jnp.lexsort((skl, skh))
+    ph, pl_, pa = key_hi[perm], key_lo[perm], active[perm]
+    same_as_prev = jnp.concatenate([
+        jnp.zeros((1,), bool),
+        (ph[1:] == ph[:-1]) & (pl_[1:] == pl_[:-1]) & pa[1:] & pa[:-1]])
+    first_of_key = jnp.zeros((BA,), bool).at[perm].set(~same_as_prev)
+    return active & first_of_key
+
+
+def probe_only(tbl_hi, tbl_lo, key_hi, key_lo, active):
+    TB = tbl_hi.shape[0]
+    bmask = jnp.uint32(TB - 1)
+    bidx = (key_lo & bmask).astype(I32)
+    row_hi, row_lo = tbl_hi[bidx], tbl_lo[bidx]
+    seen = jnp.any((row_hi == key_hi[:, None])
+                   & (row_lo == key_lo[:, None]), axis=1)
+    return active & ~seen
+
+
+def insert_only(tbl_hi, tbl_lo, key_hi, key_lo, stream):
+    TB, Sb = tbl_hi.shape
+    bmask = jnp.uint32(TB - 1)
+    bidx = (key_lo & bmask).astype(I32)
+    row_hi, row_lo = tbl_hi[bidx], tbl_lo[bidx]
+    slot_empty = (row_hi == _EMPTY) & (row_lo == _EMPTY)
+    has_empty = jnp.any(slot_empty, axis=1)
+    evict = (key_hi % jnp.uint32(Sb)).astype(I32)
+    wslot = jnp.where(has_empty, jnp.argmax(slot_empty, axis=1), evict)
+    wb = jnp.where(stream, bidx, TB)
+    tbl_hi = tbl_hi.at[wb, wslot].set(key_hi, mode="drop")
+    tbl_lo = tbl_lo.at[wb, wslot].set(key_lo, mode="drop")
+    return tbl_hi, tbl_lo
+
+
+def main() -> None:
+    backend = jax.devices()[0].platform
+    A = len(S.action_table(CFG.bounds, CFG.spec))
+    B = CFG.chunk
+    N = B * A
+    step = jax.jit(kernels.build_step(CFG.bounds, CFG.spec,
+                                      tuple(CFG.invariants),
+                                      CFG.symmetry))
+    vecs = jnp.asarray(frontier_rows(B))
+    out = jax.block_until_ready(step(vecs))
+
+    TB = TABLE // BUCKET
+    fresh = lambda: (jnp.full((TB, BUCKET), _EMPTY, U32),
+                     jnp.full((TB, BUCKET), _EMPTY, U32))
+
+    inputs = {}
+    kh = out["fp_hi"].reshape(N)
+    kl = out["fp_lo"].reshape(N)
+    act = out["valid"].reshape(N)
+    inputs["real"] = (kh, kl, act)
+    rng = np.random.default_rng(7)
+    inputs["synth"] = (
+        jnp.asarray(rng.integers(0, 1 << 32, N, dtype=np.uint64)
+                    .astype(np.uint32)),
+        jnp.asarray(rng.integers(0, 1 << 32, N, dtype=np.uint64)
+                    .astype(np.uint32)),
+        jnp.ones((N,), bool))
+
+    stats = {}
+    for nm, (h, l, a) in inputs.items():
+        hh = np.asarray(h).astype(np.uint64)
+        ll = np.asarray(l).astype(np.uint64)
+        keys = (hh << np.uint64(32)) | ll
+        aa = np.asarray(a)
+        stats[nm] = {
+            "n": int(N),
+            "active": int(aa.sum()),
+            "distinct_active_keys": int(np.unique(keys[aa]).size),
+            "distinct_inactive_keys": int(np.unique(keys[~aa]).size)
+            if (~aa).any() else 0,
+        }
+    print(json.dumps({"backend": backend, "chunk": B, "lanes": A,
+                      "table_slots": TABLE, "key_stats": stats}),
+          flush=True)
+
+    filt_nd = jax.jit(_filter_insert)
+    filt_d = jax.jit(_filter_insert, donate_argnums=(0, 1))
+    jsort = jax.jit(sort_only)
+    jprobe = jax.jit(probe_only)
+    jinsert = jax.jit(insert_only, donate_argnums=(0, 1))
+    jcopy = jax.jit(lambda th, tl: (th + jnp.uint32(0),
+                                    tl + jnp.uint32(0)))
+
+    def chain_d(th, tl, h, l, a):
+        # donated chained dispatches; fresh tables consumed
+        for _ in range(CHAIN):
+            th, tl, strm = filt_d(th, tl, h, l, a)
+        return th, tl, strm
+
+    @jax.jit
+    def loop_d(th, tl, h, l, a):
+        def body(_, c):
+            th, tl = c
+            th, tl, strm = _filter_insert(th, tl, h, l, a)
+            return th, tl
+        th, tl = jax.lax.fori_loop(0, CHAIN, body, (th, tl))
+        return th, tl
+
+    for nm, (h, l, a) in inputs.items():
+        res = {"inputs": nm}
+
+        th, tl = fresh()
+        res["full_nd_sync_ms"] = round(
+            timed_sync(filt_nd, th, tl, h, l, a) * 1e3, 3)
+        res["full_nd_async_ms"] = round(
+            timed_async(filt_nd, th, tl, h, l, a) * 1e3, 3)
+
+        # donated chain: cost per call, table threaded through
+        th, tl = fresh()
+        jax.block_until_ready(chain_d(th, tl, h, l, a))  # warm
+        th, tl = fresh()
+        t0 = time.perf_counter()
+        jax.block_until_ready(chain_d(th, tl, h, l, a))
+        res["full_chain_donated_ms"] = round(
+            (time.perf_counter() - t0) / CHAIN * 1e3, 3)
+
+        # fori_loop carry: the in-engine shape
+        th, tl = fresh()
+        jax.block_until_ready(loop_d(th, tl, h, l, a))   # warm+consume
+        th, tl = fresh()
+        t0 = time.perf_counter()
+        jax.block_until_ready(loop_d(th, tl, h, l, a))
+        res["full_loop_carry_ms"] = round(
+            (time.perf_counter() - t0) / CHAIN * 1e3, 3)
+
+        res["sort_only_ms"] = round(timed_sync(jsort, h, l, a) * 1e3, 3)
+
+        th, tl = fresh()
+        res["probe_only_ms"] = round(
+            timed_sync(jprobe, th, tl, h, l, a) * 1e3, 3)
+
+        # insert on a realistic stream mask (the full filter's own)
+        th, tl = fresh()
+        _, _, strm = jax.block_until_ready(filt_nd(th, tl, h, l, a))
+        ts = []
+        for _ in range(REPS):
+            th, tl = fresh()
+            jax.block_until_ready((th, tl))
+            t0 = time.perf_counter()
+            th, tl = jinsert(th, tl, h, l, strm)
+            jax.block_until_ready((th, tl))
+            ts.append(time.perf_counter() - t0)
+        res["insert_only_donated_ms"] = round(
+            float(np.median(ts)) * 1e3, 3)
+        res["stream_count"] = int(np.asarray(strm).sum())
+
+        th, tl = fresh()
+        res["copy_only_ms"] = round(
+            timed_sync(jcopy, th, tl) * 1e3, 3)
+
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Size probe for config #5 at 5 servers (election t2/m1, SYMMETRY
+Server): a deadline-boxed DDD BFS printing per-level growth, to decide
+whether the exact fair-lasso checker (practical to a few 1e7 states —
+liveness.py docstring) can take the full quotient graph, before
+burning hours on a blind export."""
+import json, os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+jax.config.update("jax_platforms", "cpu")
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+
+CFG = CheckConfig(
+    bounds=Bounds(n_servers=5, n_values=2, max_term=2, max_log=0,
+                  max_msgs=1, max_dup=1),
+    spec="election", invariants=(), symmetry=("Server",), chunk=1024)
+
+deadline = float(sys.argv[1]) if len(sys.argv) > 1 else 1200.0
+eng = DDDEngine(CFG, DDDCapacities(block=1 << 16, table=1 << 20,
+                                   seg_rows=1 << 17, flush=1 << 18,
+                                   levels=256, retention="frontier"))
+r = eng.check(deadline_s=deadline,
+              on_progress=lambda s: print(json.dumps(
+                  {k: s[k] for k in ("wall_s", "n_states", "level")}),
+                  flush=True))
+print(json.dumps({"final": r.n_states, "levels": r.levels,
+                  "complete": r.complete, "wall_s": round(r.wall_s, 1)}))

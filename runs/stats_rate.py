@@ -1,0 +1,51 @@
+"""Incremental rates from a --stats JSONL stream.
+
+The ``states_per_sec`` field in engine stats is CUMULATIVE (n_states /
+own wall clock), which inflates arbitrarily after a checkpoint resume —
+round 2's "164k -> 84k decay" was this artifact (RESULTS.md "an honesty
+correction").  This tool prints the true incremental rate between
+consecutive lines, plus per-level summaries.
+
+Usage:  python runs/stats_rate.py runs/elect5ddd.stats [--tail N]
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    path = sys.argv[1]
+    tail = int(sys.argv[sys.argv.index("--tail") + 1]) \
+        if "--tail" in sys.argv else 20
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    out = []
+    for a, b in zip(rows, rows[1:]):
+        dw = b["wall_s"] - a["wall_s"]
+        ds = b["n_states"] - a["n_states"]
+        if dw <= 0:
+            # wall clock restarted: a resume boundary, not a rate
+            out.append({"resume_boundary": True,
+                        "n_states": b["n_states"]})
+            continue
+        out.append({
+            "wall_s": round(b["wall_s"], 1),
+            "level": b.get("level"),
+            "n_states": b["n_states"],
+            "inc_states_per_sec": round(ds / dw, 1),
+            "cumulative_field_said": b.get("states_per_sec"),
+        })
+    for r in out[-tail:]:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+#!/bin/bash
+# Retry TPU availability; when it returns, launch the elect5 campaign
+# (frontier mode).  Refuses to launch near round end (the driver needs
+# the chip for bench, and a campaign must stop with recovery margin),
+# and kills a launched campaign at the stop deadline.
+LAUNCH_CUTOFF=$(date -u -d "2026-08-01 22:00" +%s)
+STOP_AT=$(date -u -d "2026-08-01 22:40" +%s)
+cd /root/repo/runs
+for i in $(seq 1 200); do
+  now=$(date -u +%s)
+  if [ "$now" -ge "$LAUNCH_CUTOFF" ]; then
+    echo "$(date -u) past launch cutoff; watcher exiting" >> wait_and_resume.log
+    exit 0
+  fi
+  if pgrep -f "elect5_ddd.py resume" > /dev/null; then break; fi
+  if timeout 240 python -c "import jax; jax.devices()" > /dev/null 2>&1; then
+    echo "$(date -u) TPU back after $i probes; launching campaign" >> wait_and_resume.log
+    nohup python elect5_ddd.py resume > elect5ddd_r4.out 2>&1 &
+    break
+  fi
+  echo "$(date -u) probe $i: TPU unavailable" >> wait_and_resume.log
+  sleep 120
+done
+# stop-guard: kill the campaign at STOP_AT so bench gets the chip
+while pgrep -f "elect5_ddd.py resume" > /dev/null; do
+  now=$(date -u +%s)
+  if [ "$now" -ge "$STOP_AT" ]; then
+    echo "$(date -u) stop deadline: killing campaign" >> wait_and_resume.log
+    pkill -9 -f "elect5_ddd.py resume"
+    exit 0
+  fi
+  sleep 60
+done
