@@ -988,10 +988,14 @@ class DDDEngine:
         budget = pacer.budget
         last_ckpt = time.monotonic()
 
+        prev = {"wall": 0.0, "n": n_states}   # incremental-rate anchor
+
         def progress():
             if on_progress is None:
                 return
             wall = time.monotonic() - t0
+            dn, dw = n_states - prev["n"], wall - prev["wall"]
+            prev.update(wall=wall, n=n_states)
             on_progress({
                 "wall_s": round(wall, 3),
                 "n_states": n_states + sum(
@@ -1000,7 +1004,10 @@ class DDDEngine:
                 "n_transitions": n_trans,
                 "dedup_hit_rate": round(
                     max(0.0, 1.0 - n_states / max(n_trans, 1)), 4),
+                # CUMULATIVE (inflates after resume — kept for
+                # cross-round comparability); inc_* is the honest rate
                 "states_per_sec": round(n_states / max(wall, 1e-9), 1),
+                "inc_states_per_sec": round(dn / max(dw, 1e-9), 1),
                 "route_peak": route_peak,
                 "coverage": dict(aggregate_coverage(self.table, cov)),
             })

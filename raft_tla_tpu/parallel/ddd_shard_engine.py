@@ -735,15 +735,23 @@ class DDDShardEngine:
         budget = pacer.budget
         last_ckpt = time.monotonic()
 
+        prev = {"wall": 0.0, "n": n_states}   # incremental-rate anchor
+
         def progress():
             if on_progress is None:
                 return
             wall = time.monotonic() - t0
+            # anchor the incremental rate on the same INCLUSIVE count
+            # the n_states field reports: bare n_states only advances
+            # at window-boundary drains, which would read as 0-0-spike
+            n_incl = n_states + sum(
+                sum(len(k) for k in st_["keys"]) for st_ in staging) \
+                + sum(sum(len(k) for k in p_["keys"]) for p_ in pend)
+            dn, dw = n_incl - prev["n"], wall - prev["wall"]
+            prev.update(wall=wall, n=n_incl)
             on_progress({
                 "wall_s": round(wall, 3),
-                "n_states": n_states + sum(
-                    sum(len(k) for k in st_["keys"]) for st_ in staging)
-                + sum(sum(len(k) for k in p_["keys"]) for p_ in pend),
+                "n_states": n_incl,
                 # staged counts are exact (post-dedup); pend is the raw
                 # harvested stream, so the sum is an upper bound — same
                 # contract as the single-chip engine's progress()
@@ -751,6 +759,7 @@ class DDDShardEngine:
                 "n_transitions": n_trans,
                 "n_devices": self.ndev,
                 "states_per_sec": round(n_states / max(wall, 1e-9), 1),
+                "inc_states_per_sec": round(dn / max(dw, 1e-9), 1),
                 "coverage": dict(aggregate_coverage(self.table, cov)),
             })
 
