@@ -152,4 +152,63 @@ void fingerprint_rows(const int32_t* rows, int64_t n, int32_t width,
     }
 }
 
+// Iterative Tarjan SCC over a CSR graph (the liveness fair-lasso
+// checker's scale path — Python per-node recursion tops out around a
+// few 1e7 nodes; this runs the 1e8-node graphs the 5-server election
+// quotient measures at).  comp_out[v] = component id; ids are assigned
+// in Tarjan completion order (reverse topological), which the caller
+// only uses for grouping.  Returns the number of components.
+int64_t scc_tarjan(int64_t n, const int64_t* indptr, const int64_t* dst,
+                   int64_t* comp_out) {
+    std::vector<int64_t> num(n, -1), low(n), stk, frame_v, frame_e;
+    std::vector<uint8_t> on_stk(n, 0);
+    stk.reserve(1024);
+    frame_v.reserve(1024);
+    frame_e.reserve(1024);
+    int64_t counter = 0, ncomp = 0;
+    for (int64_t root = 0; root < n; ++root) {
+        if (num[root] != -1) continue;
+        frame_v.push_back(root);
+        frame_e.push_back(indptr[root]);
+        num[root] = low[root] = counter++;
+        stk.push_back(root);
+        on_stk[root] = 1;
+        while (!frame_v.empty()) {
+            int64_t u = frame_v.back();
+            int64_t e = frame_e.back();
+            if (e < indptr[u + 1]) {
+                frame_e.back() = e + 1;
+                int64_t v = dst[e];
+                if (num[v] == -1) {
+                    num[v] = low[v] = counter++;
+                    stk.push_back(v);
+                    on_stk[v] = 1;
+                    frame_v.push_back(v);
+                    frame_e.push_back(indptr[v]);
+                } else if (on_stk[v] && num[v] < low[u]) {
+                    low[u] = num[v];
+                }
+            } else {
+                frame_v.pop_back();
+                frame_e.pop_back();
+                if (low[u] == num[u]) {
+                    int64_t w;
+                    do {
+                        w = stk.back();
+                        stk.pop_back();
+                        on_stk[w] = 0;
+                        comp_out[w] = ncomp;
+                    } while (w != u);
+                    ++ncomp;
+                }
+                if (!frame_v.empty()) {
+                    int64_t p = frame_v.back();
+                    if (low[u] < low[p]) low[p] = low[u];
+                }
+            }
+        }
+    }
+    return ncomp;
+}
+
 }  // extern "C"

@@ -88,6 +88,8 @@ def _load():
     lib.fingerprint_rows.argtypes = [
         _i32p, ctypes.c_int64, ctypes.c_int32, _u32p, _u32p,
         ctypes.c_uint32, ctypes.c_uint32, _u32p, _u32p]
+    lib.scc_tarjan.restype = ctypes.c_int64
+    lib.scc_tarjan.argtypes = [ctypes.c_int64, _i64p, _i64p, _i64p]
     return lib
 
 
@@ -422,6 +424,65 @@ class LevelStore:
     def close(self) -> None:
         self.cur.close()
         self.nxt.close()
+
+
+def scc_csr(indptr: np.ndarray, dst: np.ndarray) -> tuple:
+    """Strongly connected components of a CSR digraph: returns
+    ``(comp_id[int64 n], n_comps)``.  C++ iterative Tarjan when the
+    native library is available; NumPy-assisted iterative Tarjan in
+    Python otherwise (same ids-in-completion-order contract)."""
+    indptr = _as_i64(indptr)
+    dst = _as_i64(dst)
+    n = indptr.shape[0] - 1
+    comp = np.empty(n, np.int64)
+    if HAS_NATIVE:
+        ncomp = _lib.scc_tarjan(n, indptr.ctypes.data_as(_i64p),
+                                dst.ctypes.data_as(_i64p),
+                                comp.ctypes.data_as(_i64p))
+        return comp, int(ncomp)
+    # Python fallback: iterative Tarjan over the CSR arrays
+    num = np.full(n, -1, np.int64)
+    low = np.empty(n, np.int64)
+    on_stk = np.zeros(n, bool)
+    stk: list = []
+    counter = 0
+    ncomp = 0
+    for root in range(n):
+        if num[root] != -1:
+            continue
+        frames = [(root, int(indptr[root]))]
+        num[root] = low[root] = counter
+        counter += 1
+        stk.append(root)
+        on_stk[root] = True
+        while frames:
+            u, e = frames[-1]
+            if e < indptr[u + 1]:
+                frames[-1] = (u, e + 1)
+                v = int(dst[e])
+                if num[v] == -1:
+                    num[v] = low[v] = counter
+                    counter += 1
+                    stk.append(v)
+                    on_stk[v] = True
+                    frames.append((v, int(indptr[v])))
+                elif on_stk[v] and num[v] < low[u]:
+                    low[u] = num[v]
+            else:
+                frames.pop()
+                if low[u] == num[u]:
+                    while True:
+                        w = stk.pop()
+                        on_stk[w] = False
+                        comp[w] = ncomp
+                        if w == u:
+                            break
+                    ncomp += 1
+                if frames:
+                    p_ = frames[-1][0]
+                    if low[u] < low[p_]:
+                        low[p_] = low[u]
+    return comp, ncomp
 
 
 def fingerprint_rows(rows: np.ndarray) -> tuple:

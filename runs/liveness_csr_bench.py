@@ -1,0 +1,33 @@
+"""CSR fast-path timing on the r3 2.05M-state liveness graph (election
+3s t2/m2): graph export once, then each verdict through the new
+_check_csr (C++ Tarjan + vectorized reach/stutter) vs the r3-recorded
+list-path times (EventuallyLeader WF(Next) 25 s, stutter 16 s,
+InfinitelyOftenLeader 58 s — runs/liveness_2m.out)."""
+import json, os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+jax.config.update("jax_platforms", "cpu")
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.ddd_engine import DDDCapacities
+from raft_tla_tpu.models import liveness
+
+CFG = CheckConfig(
+    bounds=Bounds(n_servers=3, n_values=2, max_term=2, max_log=0,
+                  max_msgs=2, max_dup=1),
+    spec="election", invariants=(), chunk=1024)
+t0 = time.time()
+g = liveness.ddd_graph(CFG, DDDCapacities(block=1 << 16, table=1 << 20,
+                                          seg_rows=1 << 17,
+                                          flush=1 << 18, levels=256))
+print(json.dumps({"phase": "graph", "states": len(g[0]),
+                  "edges": g[1].n_edges,
+                  "wall_s": round(time.time() - t0, 1)}), flush=True)
+for prop, wf in (("EventuallyLeader", ("Next",)),
+                 ("EventuallyLeader", ()),
+                 ("InfinitelyOftenLeader", ("Next",))):
+    t1 = time.time()
+    r = liveness.check(CFG, prop, wf=wf, graph=g)
+    print(json.dumps({"prop": prop, "wf": list(wf), "holds": r.holds,
+                      "wall_s": round(time.time() - t1, 2),
+                      "n_sccs_checked": r.n_sccs_checked}), flush=True)
+g[0].close()

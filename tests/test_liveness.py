@@ -273,3 +273,18 @@ def test_ddd_graph_full_spec_crash_loop():
     r = liveness.check(FULL, "EventuallyLeader", wf=("Next",), graph=g)
     assert not r.holds            # Restart churn refutes it
     g[0].close()
+
+
+def test_csr_path_absent_family_refutes_not_crashes():
+    """WF of a family valid in ALL_FAMILIES but absent from the spec
+    subset (e.g. ClientRequest under the election spec): everywhere-
+    disabled, so any eventuality refutes by stuttering — CSR and list
+    paths must agree (a review found the CSR path raising ValueError)."""
+    g_csr = liveness.ddd_graph(ELECTION, _ddd_caps())
+    g_list = liveness.explore_graph(ELECTION)
+    r_csr = liveness.check(ELECTION, "EventuallyLeader",
+                           wf=("ClientRequest",), graph=g_csr)
+    r_list = liveness.check(ELECTION, "EventuallyLeader",
+                            wf=("ClientRequest",), graph=g_list)
+    assert r_csr.holds == r_list.holds is False
+    g_csr[0].close()
