@@ -53,7 +53,11 @@ def main() -> None:
                       "wall_s": round(time.time() - t0, 1)}), flush=True)
     for prop, wf in (("EventuallyLeader", ("Next",)),
                      ("EventuallyLeader", ()),
-                     ("InfinitelyOftenLeader", ("Next",))):
+                     ("EventuallyLeader", ("Timeout",)),
+                     ("EventuallyLeader", ("Timeout", "RequestVote",
+                                           "BecomeLeader", "Receive")),
+                     ("InfinitelyOftenLeader", ("Next",)),
+                     ("InfinitelyOftenLeader", ())):
         t1 = time.time()
         r = liveness.check(CFG, prop, wf=wf, graph=graph)
         print(json.dumps({
