@@ -91,3 +91,35 @@ def test_cpp_store_matches_py_store_on_random_ops():
     n = int(rng.integers(1, len(a) - start))
     np.testing.assert_array_equal(a.read(start, n), b.read(start, n))
     a.close()
+
+
+def test_scc_csr_native_matches_python_fallback():
+    """Both scc_csr implementations must induce the same partition
+    (component ids may differ; membership must not) on random digraphs."""
+    import numpy as np
+
+    from raft_tla_tpu.utils import native
+
+    rng = np.random.default_rng(3)
+    for n, m in ((1, 0), (8, 12), (64, 200), (300, 1500)):
+        src = rng.integers(0, n, m)
+        dst = rng.integers(0, n, m).astype(np.int64)
+        order = np.argsort(src, kind="stable")
+        src, dst = src[order], dst[order]
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(np.bincount(src, minlength=n), out=indptr[1:])
+
+        comp_n, nc_n = native.scc_csr(indptr, dst)
+        # force the Python fallback
+        saved = native.HAS_NATIVE
+        native.HAS_NATIVE = False
+        try:
+            comp_p, nc_p = native.scc_csr(indptr, dst)
+        finally:
+            native.HAS_NATIVE = saved
+        assert nc_n == nc_p
+        # same partition: the id-of-id mapping must be a bijection
+        pairs = {(int(a), int(b)) for a, b in zip(comp_n, comp_p)}
+        assert len(pairs) == nc_n
+        assert len({a for a, _ in pairs}) == nc_n
+        assert len({b for _, b in pairs}) == nc_n
