@@ -1,0 +1,30 @@
+"""Scale validation for the CSR fair-lasso machinery (no chip needed):
+C++ Tarjan SCC + delta-frontier reachability on a synthetic 10M-node /
+30M-edge digraph — the size class the 5-server liveness quotient
+measures at (runs/liveness5_probe.out extrapolation)."""
+import json, os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+from raft_tla_tpu.models.liveness import _csr_reach
+from raft_tla_tpu.utils import native
+
+N, M = 10_000_000, 30_000_000
+rng = np.random.default_rng(0)
+src = rng.integers(0, N, M)
+dst = rng.integers(0, N, M).astype(np.int64)
+order = np.argsort(src, kind="stable")
+src, dst = src[order], dst[order]
+indptr = np.zeros(N + 1, np.int64)
+np.cumsum(np.bincount(src, minlength=N), out=indptr[1:])
+del src, order
+
+t0 = time.time()
+comp, nc = native.scc_csr(indptr, dst)
+t_scc = time.time() - t0
+t0 = time.time()
+reach = _csr_reach(indptr, dst, 0, N)
+t_reach = time.time() - t0
+print(json.dumps({
+    "nodes": N, "edges": M, "n_sccs": int(nc),
+    "scc_wall_s": round(t_scc, 1), "reach_wall_s": round(t_reach, 1),
+    "reachable": int(reach.sum()), "native": native.HAS_NATIVE}))
