@@ -125,20 +125,79 @@ def run_northstar() -> None:
     }))
 
 
-def main() -> None:
-    # -- part 1: the north-star probe --------------------------------------
-    proc = subprocess.run(
-        [sys.executable, __file__, "--northstar"],
-        capture_output=True, text=True, timeout=900)
+# Set by main() once part 1 succeeds, so a later toy-suite failure still
+# reports the measured headline instead of discarding it.
+_partial: dict = {}
+
+
+def _emit_error(reason: str) -> None:
+    """The driver's scoreboard must be a parseable JSON line even when the
+    chip is dead (VERDICT r4 weak #1: BENCH_r04.json was a traceback)."""
+    print(json.dumps({
+        "metric": "symmetric_fullnext_orbits_per_sec_single_chip",
+        "value": _partial.get("value", 0.0), "unit": "orbits/s",
+        "vs_baseline": _partial.get("vs_baseline", 0.0),
+        "error": reason, **{k: v for k, v in _partial.items()
+                            if k not in ("value", "vs_baseline")},
+    }))
+    sys.exit(0)
+
+
+def _child(args: list, timeout: float, what: str) -> dict:
+    """Run a bench child; on ANY failure emit the error JSON line and exit.
+
+    A dead TPU tunnel makes the child's first dispatch hang forever — the
+    in-engine deadline never fires because the deadline check itself sits
+    behind a wedged ``block_until_ready`` — so the parent-side timeout is
+    the only reliable box."""
+    try:
+        proc = subprocess.run([sys.executable, __file__, *args],
+                              capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        for stream in (e.stdout, e.stderr):   # partial output locates the wedge
+            if stream:
+                sys.stderr.write(stream if isinstance(stream, str)
+                                 else stream.decode(errors="replace"))
+        print(f"bench {what}: timed out after {timeout:.0f}s",
+              file=sys.stderr)
+        _emit_error(f"{what}_timeout")
     if proc.returncode != 0:
         sys.stderr.write(proc.stderr)
-        print("bench northstar probe failed", file=sys.stderr)
-        sys.exit(1)
-    ns = json.loads(proc.stdout.strip().splitlines()[-1])
+        print(f"bench {what} failed (rc={proc.returncode})", file=sys.stderr)
+        _emit_error(f"{what}_failed")
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        sys.stderr.write(proc.stdout)
+        _emit_error(f"{what}_unparseable")
+
+
+def main() -> None:
+    # -- part 0: device preflight ------------------------------------------
+    # ~60 s probe: a dead tunnel hangs jax device init forever; fail fast
+    # with an explicit marker instead of letting the driver's timeout hit.
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); assert d; print(d[0].platform)"],
+            capture_output=True, text=True, timeout=75)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+            print(f"bench preflight: device probe failed "
+                  f"(rc={proc.returncode})", file=sys.stderr)
+            _emit_error("device_probe_failed")
+    except subprocess.TimeoutExpired:
+        print("bench preflight: no usable device in 75s", file=sys.stderr)
+        _emit_error("tpu_unavailable")
+    print(f"bench preflight: device platform "
+          f"{proc.stdout.strip()!r}", file=sys.stderr)
+
+    # -- part 1: the north-star probe --------------------------------------
+    ns = _child(["--northstar"], timeout=480, what="northstar")
     if ns["violation"]:
         print("bench northstar: unexpected invariant violation",
               file=sys.stderr)
-        sys.exit(1)
+        _emit_error("northstar_violation")
     rate = ns["orbits_per_sec"]
     if ns["complete"]:
         # the probe ran the whole flagship space inside the box (a future-
@@ -152,23 +211,22 @@ def main() -> None:
           f"{rate:,.0f} orbits/s -> projected flagship "
           f"(94.4M-orbit) wall {projected_flagship_wall:,.0f}s",
           file=sys.stderr)
+    # part 1 is the headline; keep it even if the toy suite fails below
+    _partial.update({
+        "value": round(rate, 1),
+        "vs_baseline": round(60.0 / projected_flagship_wall, 4),
+        "projected_flagship_wall_s": round(projected_flagship_wall, 1),
+    })
 
     # -- part 2: the toy suite (secondary) ---------------------------------
     total_states = 0
     total_wall = 0.0
     for idx in range(SUITE_SIZE):
-        proc = subprocess.run(
-            [sys.executable, __file__, "--one", str(idx)],
-            capture_output=True, text=True, timeout=900)
-        if proc.returncode != 0:
-            sys.stderr.write(proc.stderr)
-            print(f"bench entry {idx} failed", file=sys.stderr)
-            sys.exit(1)
-        r = json.loads(proc.stdout.strip().splitlines()[-1])
+        r = _child(["--one", str(idx)], timeout=150, what=f"toy{idx}")
         if r["violation"]:
             print(f"bench {r['name']}: unexpected invariant violation",
                   file=sys.stderr)
-            sys.exit(1)
+            _emit_error(f"toy{idx}_violation")
         total_states += r["n_states"]
         total_wall += r["wall_s"]
         print(f"{r['name']}: {r['n_states']} states, diameter "

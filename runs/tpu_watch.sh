@@ -1,0 +1,12 @@
+#!/bin/bash
+# Probe the TPU tunnel every 5 min; exit 0 the moment it answers.
+for i in $(seq 1 120); do
+  if timeout 90 python -c "import jax; d=jax.devices(); assert d; print(d)" >/tmp/tpu_probe.out 2>&1; then
+    echo "$(date -u) probe $i: TPU AVAILABLE: $(cat /tmp/tpu_probe.out)"
+    exit 0
+  fi
+  echo "$(date -u) probe $i: TPU unavailable"
+  sleep 240
+done
+echo "$(date -u) watcher exhausted 120 probes"
+exit 1
