@@ -747,8 +747,12 @@ class DDDShardEngine:
             n_incl = n_states + sum(
                 sum(len(k) for k in st_["keys"]) for st_ in staging) \
                 + sum(sum(len(k) for k in p_["keys"]) for p_ in pend)
-            dn, dw = n_incl - prev["n"], wall - prev["wall"]
-            prev.update(wall=wall, n=n_incl)
+            # rate anchors on the running max: pend is pre-dedup, so the
+            # inclusive count can dip after a drain — never report a
+            # negative rate
+            anchor = max(prev["n"], n_incl)
+            dn, dw = anchor - prev["n"], wall - prev["wall"]
+            prev.update(wall=wall, n=anchor)
             on_progress({
                 "wall_s": round(wall, 3),
                 "n_states": n_incl,

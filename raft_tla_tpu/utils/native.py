@@ -330,6 +330,9 @@ class FileStore:
         synced after the surviving metadata npz must be re-discovered,
         not trusted)."""
         n_local = n_global - self.base
+        if n_local < 0:
+            raise ValueError(
+                f"trim to {n_global} below stream base {self.base}")
         if n_local < self._n:
             self._n = n_local
             self._f.truncate(16 + n_local * self.width * 4)
@@ -380,7 +383,13 @@ class LevelStore:
         return self.nxt.append(rows)
 
     def read(self, start: int, n: int) -> np.ndarray:
+        """Read ``n`` rows from ONE level (the engines clamp blocks to
+        the level end, so a range never spans the cur/nxt boundary)."""
         store = self.nxt if start >= self.nxt.base else self.cur
+        if store is self.cur and start + n > len(self.cur):
+            raise IndexError(
+                f"read [{start}, {start + n}) spans the level boundary "
+                f"at {len(self.cur)} — single-level reads only")
         return store.read(start, n)
 
     def rotate(self, delete_old: bool = False) -> None:
