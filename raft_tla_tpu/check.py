@@ -136,6 +136,12 @@ def build_argparser() -> argparse.ArgumentParser:
                         "links (violations report the state, not a path "
                         "— TLC -noTrace).  ~16 B/state instead of ~76: "
                         "the campaign mode for 10^9+-state spaces")
+    p.add_argument("--keep-levels", action="store_true",
+                   help="--retention frontier: retain ALL level files "
+                        "(TLC's states/ disk regime) so a violation "
+                        "reconstructs a full trace by backward "
+                        "re-search; costs the rows-stream disk "
+                        "footprint")
     p.add_argument("--cp-lanes", action="store_true",
                    help="--engine ddd-shard only: CP mode — shard the "
                         "bag-scan ACTION lanes across the mesh instead "
@@ -411,7 +417,7 @@ def _run(args, config):
         eng = DDDEngine(config, DDDCapacities(
             block=args.block or 1 << 20, table=table, seg_rows=seg_rows,
             levels=args.levels, route_rows=args.route,
-            retention=args.retention))
+            retention=args.retention, keep_levels=args.keep_levels))
         return eng.check(on_progress=_stats_cb(args),
                          checkpoint=args.checkpoint,
                          checkpoint_every_s=args.checkpoint_every,
@@ -433,7 +439,7 @@ def _run(args, config):
         eng = DDDShardEngine(config, mesh, DDDShardCapacities(
             block=blk, table=table, seg_rows=seg_rows,
             levels=args.levels, cp=args.cp_lanes,
-            retention=args.retention))
+            retention=args.retention, keep_levels=args.keep_levels))
         return eng.check(on_progress=_stats_cb(args),
                          checkpoint=args.checkpoint,
                          checkpoint_every_s=args.checkpoint_every,

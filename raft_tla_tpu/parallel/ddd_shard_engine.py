@@ -75,9 +75,9 @@ from raft_tla_tpu.device_engine import (
     _EMPTY, BUCKET, FAIL_INDEX, FAIL_LEVEL, FAIL_ROUTE, FAIL_WIDTH,
     aggregate_coverage, decode_fail)
 from raft_tla_tpu.ddd_engine import (
-    _filter_insert, _IDX_CEIL, frontier_checkpoint_setup,
-    load_ddd_snapshot, load_frontier_snapshot, save_ddd_snapshot,
-    save_frontier_snapshot)
+    _filter_insert, _IDX_CEIL, frontier_backtrace,
+    frontier_checkpoint_setup, load_ddd_snapshot,
+    load_frontier_snapshot, save_ddd_snapshot, save_frontier_snapshot)
 from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import bitpack
@@ -119,6 +119,9 @@ class DDDShardCapacities:
     # Shares the frontier snapshot format and migration with the
     # single-chip engine.
     retention: str = "full"
+    # Retain ALL frontier level files for counterexample backtrace
+    # (ddd_engine.DDDCapacities.keep_levels docs); tuning, not digest.
+    keep_levels: bool = False
     # CP mode (SURVEY §2.9 CP row): every shard expands the SAME window
     # rows over its lane slice (parallel/cp_expand) instead of its own
     # row slice over all lanes — the bag-scan axis shards, the frontier
@@ -581,7 +584,8 @@ class DDDShardEngine:
         if self.caps.retention == "frontier":
             save_frontier_snapshot(path, host, constore, keystore,
                                    n_states, n_trans, cov, level_ends,
-                                   blocks_done, digest)
+                                   blocks_done, digest,
+                                   keep_levels=self.caps.keep_levels)
         else:
             save_ddd_snapshot(path, host, constore, keystore, n_states,
                               n_trans, cov, level_ends, blocks_done,
@@ -903,8 +907,10 @@ class DDDShardEngine:
                 # finished level's rows are dead weight (snapshots keep
                 # files alive until their npz commits; tmpdir runs have
                 # nothing to resume — delete immediately)
-                host.rotate(delete_old=tmpdir is not None)
-                constore.rotate(delete_old=tmpdir is not None)
+                keep = self.caps.keep_levels
+                host.rotate(delete_old=tmpdir is not None and not keep)
+                constore.rotate(delete_old=tmpdir is not None
+                                and not keep)
             progress()
             if len(level_ends) > self.caps.levels:
                 raise RuntimeError(
@@ -944,13 +950,22 @@ class DDDShardEngine:
                 viol_g = ref
                 inv_name = DEADLOCK
             if self.caps.retention == "frontier":
-                # no trace links (TLC -noTrace): report the state
+                # no trace links; keep_levels restores the full trace
+                # via backward re-search (ddd_engine.frontier_backtrace
+                # — the level files are mesh-agnostic global streams),
+                # else TLC -noTrace: report the state
                 row = self.schema.unpack(host.read(int(viol_g), 1)[0],
                                          np)
                 py = interp.from_struct(st.unpack(row, self.lay, np),
                                         self.bounds)
+                host.sync()
+                constore.sync()
+                trace = frontier_backtrace(
+                    self.config, self.schema, self.lay, self.bounds,
+                    self.table, checkpoint, level_ends, n_states,
+                    int(viol_g), keystore)
                 violation = Violation(invariant=inv_name, state=py,
-                                      trace=[(None, py)])
+                                      trace=trace or [(None, py)])
             else:
                 chain_idx = host.trace_chain(viol_g)
                 chain = []

@@ -397,6 +397,11 @@ class LevelStore:
         ``delete_old`` removes the finished level's file immediately —
         only sound when no snapshot will ever resume from it."""
         old_path = self.cur.path
+        if not delete_old:
+            # commit the header: close() alone leaves the count stale,
+            # and anything reopening the file (backtrace over retained
+            # levels) would truncate the data to the stale count
+            self.cur.sync()
         self.cur.close()
         if delete_old:
             try:

@@ -218,3 +218,95 @@ def test_mesh_frontier_checkpoint_resume_and_reshard(tmp_path):
     assert got2.n_states == ref.n_states
     assert got2.diameter == ref.diameter
     assert got2.n_transitions == ref.n_transitions
+
+# -- keep_levels: TLC's states/-dir regime -> full traces ----------------
+
+VIOL_CFG = CheckConfig(
+    bounds=Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                  max_msgs=1),
+    spec="election", invariants=("NaiveNoTwoLeaders",), chunk=256)
+
+
+def _assert_replayable(trace, cfg):
+    """Every edge of the reconstructed trace must be a real interpreter
+    transition with the claimed action label (no-symmetry configs)."""
+    from raft_tla_tpu.models import interp, spec as S
+    table = S.action_table(cfg.bounds, cfg.spec)
+    assert trace[0][0] is None
+    assert trace[0][1] == interp.init_state(cfg.bounds)
+    for (_, prev), (label, cur) in zip(trace, trace[1:]):
+        succ = [(table[i].label(), n)
+                for i, n in interp.successors(prev, cfg.bounds, table,
+                                              cfg.spec)]
+        assert (label, cur) in succ
+
+
+def test_frontier_keep_levels_full_violation_trace():
+    got = DDDEngine(VIOL_CFG, _caps(keep_levels=True)).check()
+    assert got.violation is not None
+    full = DDDEngine(VIOL_CFG, _caps(retention="full")).check()
+    # same violating endpoint, same (shortest) trace length as the
+    # link-following full-retention trace, every edge replayable
+    assert got.violation.state == full.violation.state
+    assert len(got.violation.trace) == len(full.violation.trace)
+    assert got.violation.trace[-1][1] == got.violation.state
+    _assert_replayable(got.violation.trace, VIOL_CFG)
+
+
+def test_frontier_keep_levels_trace_with_checkpointing(tmp_path):
+    # snapshots must not garbage-collect the retained level files
+    ck = str(tmp_path / "run")
+    got = DDDEngine(VIOL_CFG, _caps(keep_levels=True)).check(
+        checkpoint=ck, checkpoint_every_s=0.0)
+    assert got.violation is not None
+    assert len(got.violation.trace) > 1
+    _assert_replayable(got.violation.trace, VIOL_CFG)
+    # every level file from L1 up survives on disk
+    n_levels = len(glob.glob(ck + ".rowsL*"))
+    assert n_levels >= len(got.violation.trace)
+
+
+def test_frontier_keep_levels_deadlock_trace():
+    cfg = CheckConfig(
+        bounds=Bounds(n_servers=1, n_values=1, max_term=2, max_log=0,
+                      max_msgs=2),
+        spec="election", invariants=(), check_deadlock=True, chunk=64)
+    got = DDDEngine(cfg, _caps(block=1 << 8, keep_levels=True)).check()
+    ref = refbfs.check(cfg)
+    assert got.violation is not None
+    assert got.violation.invariant == ref.violation.invariant
+    assert len(got.violation.trace) == len(ref.violation.trace)
+    _assert_replayable(got.violation.trace, cfg)
+
+
+def test_frontier_keep_levels_shard_trace():
+    from raft_tla_tpu.parallel.ddd_shard_engine import (
+        DDDShardCapacities, DDDShardEngine)
+    from raft_tla_tpu.parallel.shard_engine import make_mesh
+    caps = DDDShardCapacities(block=256, table=1 << 14,
+                              seg_rows=1 << 14, flush=1 << 12,
+                              levels=64, retention="frontier",
+                              keep_levels=True)
+    got = DDDShardEngine(VIOL_CFG, make_mesh(2), caps).check()
+    assert got.violation is not None
+    full = DDDEngine(VIOL_CFG, _caps(retention="full")).check()
+    assert len(got.violation.trace) == len(full.violation.trace)
+    assert got.violation.trace[-1][1] == got.violation.state
+    _assert_replayable(got.violation.trace, VIOL_CFG)
+
+
+def test_frontier_keep_levels_trace_composes_with_symmetry():
+    cfg = CheckConfig(
+        bounds=VIOL_CFG.bounds, spec="election",
+        invariants=("NaiveNoTwoLeaders",), symmetry=("Server",),
+        chunk=256)
+    got = DDDEngine(cfg, _caps(keep_levels=True)).check()
+    full = DDDEngine(cfg, _caps(retention="full")).check()
+    assert got.violation is not None and full.violation is not None
+    # states are canonical orbit representatives; the trace matches the
+    # full-retention link trace in endpoint and (shortest) length
+    assert got.violation.state == full.violation.state
+    assert len(got.violation.trace) == len(full.violation.trace)
+    assert got.violation.trace[-1][1] == got.violation.state
+    assert got.violation.trace[0][0] is None
+    assert all(lbl is not None for lbl, _ in got.violation.trace[1:])
