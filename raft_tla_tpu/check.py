@@ -166,11 +166,13 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="also write MCraft.tla/MCraft.cfg for a stock-TLC "
                         "parity run, then continue")
     p.add_argument("--property", action="append", default=[],
-                   metavar="NAME",
-                   help="liveness property to check under weak fairness "
-                        "(host-side SCC analysis; registry: "
-                        "models/liveness.PROPERTIES). Also read from the "
-                        "cfg's PROPERTY stanza")
+                   metavar="NAME_OR_FORMULA",
+                   help="temporal property to check under weak fairness: "
+                        "a registered name (models/liveness.PROPERTIES) "
+                        "or a formula '<>P', '[]<>P', 'P ~> Q' over "
+                        "registered predicates (models/liveness."
+                        "PREDICATES). Also read from the cfg's PROPERTY "
+                        "stanza")
     p.add_argument("--wf", default="Next",
                    help="comma-separated action families assumed weakly "
                         "fair for --property (default: Next = the whole "
@@ -233,12 +235,8 @@ def _resolve_config(args):
             f"unknown invariant(s) {unknown}; registry: "
             f"{sorted(inv_mod.REGISTRY)}")
     from raft_tla_tpu.models import liveness as live_mod
-    bad_props = [nm for nm in cfg.properties
-                 if nm not in live_mod.PROPERTIES]
-    if bad_props:
-        raise ValueError(
-            f"unknown PROPERTY {bad_props}; registry: "
-            f"{sorted(live_mod.PROPERTIES)}")
+    for nm in cfg.properties:
+        live_mod.parse_property(nm)     # raises with both registries
     sym_names = set(cfg.symmetry) | ({"Server"} if args.symmetry else set())
     bad_sym = sym_names - {"Server", "SymServer", "Value", "SymValue",
                            "SymServerValue"}
@@ -278,11 +276,8 @@ def _resolve_config(args):
         history=args.faithful, max_elections=args.max_elections)
     props = list(cfg.properties) + [nm for nm in args.property
                                      if nm not in cfg.properties]
-    bad_props = [nm for nm in props if nm not in live_mod.PROPERTIES]
-    if bad_props:
-        raise ValueError(
-            f"unknown --property {bad_props}; registry: "
-            f"{sorted(live_mod.PROPERTIES)}")
+    for nm in props:
+        live_mod.parse_property(nm)     # raises with both registries
     return CheckConfig(bounds=bounds, spec=args.spec,
                        invariants=tuple(cfg.invariants), symmetry=symmetry,
                        chunk=args.chunk,
@@ -548,7 +543,9 @@ def main(argv=None) -> int:
                                           parity_view=not b.history,
                                           symmetry=config.symmetry,
                                           view=config.view,
-                                          spec=config.spec)
+                                          spec=config.spec,
+                                          properties=tuple(props),
+                                          wf=_parse_wf(args))
         except (OSError, ValueError) as e:
             print(f"Error: {e}", file=sys.stderr)
             return EXIT_ERROR
@@ -690,11 +687,20 @@ def main(argv=None) -> int:
     return EXIT_DEADLOCK if is_deadlock else EXIT_VIOLATION
 
 
+def _parse_wf(args) -> tuple:
+    """--wf families; 'none' = no fairness (the raw reference Spec).
+    One definition for the checker AND the TLC twin emitter, so the
+    emitted FairSpec always encodes the same fairness as the verdict."""
+    if args.wf.strip().lower() == "none":
+        return ()
+    return tuple(f.strip() for f in args.wf.split(",") if f.strip())
+
+
 def _check_liveness(args, config, props) -> int:
     from raft_tla_tpu.models import liveness
     from raft_tla_tpu.utils.render import render_state
 
-    wf = () if args.wf.strip().lower() == "none" else         tuple(f.strip() for f in args.wf.split(",") if f.strip())
+    wf = _parse_wf(args)
     # Build the behavior graph once for all properties.  Symmetric runs
     # and the DDD engines use the DDD-store export (orbit-quotient
     # soundness argument in liveness.ddd_graph; no device-table
@@ -745,9 +751,13 @@ def _report_liveness(args, config, props, wf, graph) -> int:
             print(f"Error: {e}", file=sys.stderr)
             return EXIT_ERROR
         wall = time.monotonic() - t0
-        form = liveness.PROPERTIES[nm][0]
+        pspec = liveness.parse_property(nm)
+        shape = f"{pspec.pred_names[0]} ~> {pspec.pred_names[1]}" \
+            if pspec.form == liveness.LEADS_TO \
+            else f"{pspec.form}{pspec.pred_names[0]}"
+        shape_txt = f" ({shape})" if shape != nm else ""
         wf_txt = ", ".join(wf) if wf else "no fairness (raw Spec)"
-        print(f"Property {nm} ({form}P) under WF({wf_txt}): "
+        print(f"Property {nm}{shape_txt} under WF({wf_txt}): "
               f"{res.n_states} states, {res.n_edges} transitions, "
               f"{wall:.2f}s.")
         if res.holds:

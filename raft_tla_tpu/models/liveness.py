@@ -50,6 +50,7 @@ from raft_tla_tpu.models import interp, spec as S
 
 EVENTUALLY = "<>"
 INFINITELY_OFTEN = "[]<>"
+LEADS_TO = "~>"
 
 
 def _some_leader(s, bounds: Bounds) -> bool:
@@ -60,6 +61,33 @@ def _some_commit(s, bounds: Bounds) -> bool:
     return any(ci > 0 for ci in s.commitIndex)
 
 
+def _some_candidate(s, bounds: Bounds) -> bool:
+    return any(r == S.CANDIDATE for r in s.role)
+
+
+# State-predicate registry for cfg/CLI temporal FORMULAS (VERDICT r4
+# missing #4): name -> (PyState predicate, struct-of-arrays vector twin,
+# TLA+ text for the --emit-tlc twin).  Every registered predicate must
+# be PERMUTATION-INVARIANT (reads role/commitIndex as sets) — that is
+# what makes the orbit-quotient check of ddd_graph sound.  The vector
+# twins evaluate over unpacked chunks with a leading batch dim (a
+# million PyState materializations just to test ``any(role == Leader)``
+# is the host loop the graph exports exist to avoid).
+PREDICATES = {
+    "SomeLeader": (
+        _some_leader,
+        lambda st_, b: (st_["role"] == S.LEADER).any(-1),
+        "\\E i \\in Server : state[i] = Leader"),
+    "SomeCandidate": (
+        _some_candidate,
+        lambda st_, b: (st_["role"] == S.CANDIDATE).any(-1),
+        "\\E i \\in Server : state[i] = Candidate"),
+    "SomeCommit": (
+        _some_commit,
+        lambda st_, b: (st_["commitIndex"] > 0).any(-1),
+        "\\E i \\in Server : commitIndex[i] > 0"),
+}
+
 PROPERTIES = {
     # Raft's headline liveness claims, both refutable even under full weak
     # fairness (dueling candidates / fault churn) — finding the refuting
@@ -69,18 +97,69 @@ PROPERTIES = {
     "InfinitelyOftenLeader": (INFINITELY_OFTEN, _some_leader),
 }
 
-# Vectorized twins over unpacked struct-of-arrays chunks (leading batch
-# dim), for predicate evaluation at engine-store scale — a million
-# PyState materializations just to test `any(role == Leader)` is the
-# kind of host loop the graph exports exist to avoid.  Every registered
-# predicate is PERMUTATION-INVARIANT (reads role/commitIndex as sets),
-# which is what makes the orbit-quotient check of ddd_graph sound.
-_STRUCT_PREDICATES = {
-    "EventuallyLeader": lambda st_, b: (st_["role"] == S.LEADER).any(-1),
-    "EventuallyCommit": lambda st_, b: (st_["commitIndex"] > 0).any(-1),
-    "InfinitelyOftenLeader":
-        lambda st_, b: (st_["role"] == S.LEADER).any(-1),
+# the named properties, expressed over the predicate registry (what
+# parse_property resolves them to)
+_NAMED = {
+    "EventuallyLeader": (EVENTUALLY, ("SomeLeader",)),
+    "EventuallyCommit": (EVENTUALLY, ("SomeCommit",)),
+    "InfinitelyOftenLeader": (INFINITELY_OFTEN, ("SomeLeader",)),
 }
+
+# back-compat alias (older call sites key vectorized masks by property
+# name; new code keys by predicate name through PREDICATES)
+_STRUCT_PREDICATES = {
+    nm: PREDICATES[preds[0]][1] for nm, (_f, preds) in _NAMED.items()
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class PropSpec:
+    """A resolved temporal property: a registered name or a parsed
+    formula of one of the three supported shapes."""
+
+    text: str           # display form (the input string)
+    form: str           # EVENTUALLY | INFINITELY_OFTEN | LEADS_TO
+    pred_names: tuple   # 1 predicate (<>P, []<>P) or 2 (P ~> Q)
+
+    def preds(self):
+        return tuple(PREDICATES[nm][0] for nm in self.pred_names)
+
+
+def parse_property(text: str) -> PropSpec:
+    """Resolve a cfg/CLI PROPERTY entry: a registered property name
+    (``EventuallyLeader``), or a temporal formula ``<>P`` / ``[]<>P`` /
+    ``P ~> Q`` over registered predicate names (TLC's PROPERTY grammar
+    restricted to the shapes the lasso checker decides)."""
+    t = " ".join(text.split())
+    if t in _NAMED:
+        form, preds = _NAMED[t]
+        return PropSpec(text=t, form=form, pred_names=preds)
+
+    def _pred(nm):
+        nm = nm.strip()
+        if nm not in PREDICATES:
+            raise ValueError(
+                f"unknown predicate {nm!r} in PROPERTY {text!r}; "
+                f"registry: {sorted(PREDICATES)}")
+        return nm
+
+    if "~>" in t:
+        lhs, _, rhs = t.partition("~>")
+        if not lhs.strip() or not rhs.strip():
+            raise ValueError(f"malformed PROPERTY {text!r}: "
+                             "expected 'P ~> Q'")
+        return PropSpec(text=t, form=LEADS_TO,
+                        pred_names=(_pred(lhs), _pred(rhs)))
+    if t.startswith("[]<>"):
+        return PropSpec(text=t, form=INFINITELY_OFTEN,
+                        pred_names=(_pred(t[4:]),))
+    if t.startswith("<>"):
+        return PropSpec(text=t, form=EVENTUALLY,
+                        pred_names=(_pred(t[2:]),))
+    raise ValueError(
+        f"unknown PROPERTY {text!r}: not a registered property "
+        f"({sorted(_NAMED)}) nor a formula of shape '<>P', '[]<>P' or "
+        f"'P ~> Q' over registered predicates ({sorted(PREDICATES)})")
 
 
 @dataclasses.dataclass
@@ -302,13 +381,14 @@ class StatesView:
                                   self._bounds)
 
     def mask(self, prop: str):
-        """Vectorized ``[n]`` bool array of the property's predicate;
-        falls back to the scalar predicate for properties without a
-        registered vector twin."""
+        """Vectorized ``[n]`` bool array of a predicate (by PREDICATES
+        name, or property name for back-compat); falls back to the
+        scalar predicate when no vector twin is registered."""
         from raft_tla_tpu.ops import state as st
 
         np = self._np
-        fn = _STRUCT_PREDICATES.get(prop)
+        fn = PREDICATES[prop][1] if prop in PREDICATES \
+            else _STRUCT_PREDICATES.get(prop)
         if fn is None:
             _form, pred = PROPERTIES[prop]
             return np.asarray([pred(self[u], self._bounds)
@@ -521,10 +601,23 @@ def _sccs(n: int, adj) -> list:
 
 def _path(adj_labeled, src: int, dsts: set):
     """BFS path src -> (first reachable of dsts); [(aidx, node), ...]."""
-    if src in dsts:
-        return []
-    prev = {src: None}
-    frontier = [src]
+    hit = _path_multi(adj_labeled, [src], dsts)
+    return hit[1] if hit is not None else None
+
+
+
+def _path_multi(adj_labeled, srcs, dsts):
+    """BFS from MANY sources: ``(origin_src, [(aidx, node), ...])`` to
+    the first reachable member of ``dsts``, or None."""
+    prev = {}
+    frontier = []
+    for s in srcs:
+        if s in prev:
+            continue
+        prev[s] = None
+        if s in dsts:
+            return s, []
+        frontier.append(s)
     while frontier:
         nxt = []
         for u in frontier:
@@ -540,22 +633,39 @@ def _path(adj_labeled, src: int, dsts: set):
                         path.append((pa, cur))
                         cur = pu
                     path.reverse()
-                    return path
+                    return cur, path
                 nxt.append(v)
         frontier = nxt
     return None
 
 
+def _leadsto_prefix(full_adj, sub_adj, seeds, entry):
+    """Two-leg prefix for a refuted ``P ~> Q``: Init -> (any states) ->
+    a P-and-not-Q seed -> (~Q states only) -> the lasso entry.  The
+    second leg runs first (multi-source, so it picks a seed that
+    actually reaches the entry inside the restricted region)."""
+    hit = _path_multi(sub_adj, seeds, {entry})
+    if hit is None:
+        raise RuntimeError(         # entry ∈ reach(seeds) by construction
+            "leads-to prefix: lasso entry unreachable from seeds")
+    origin, leg2 = hit
+    leg1 = _path(full_adj, 0, {origin}) or []
+    return leg1 + leg2
+
 
 def _csr_reach(indptr, dst, src0, n):
     """Vectorized BFS reachability over a CSR digraph: bool[n] with
-    reach[src0]=True; per-round cost proportional to the DELTA
-    frontier's edges (ragged-arange gather), total O(E)."""
+    reach[srcs]=True; ``src0`` is one root or an array of roots
+    (multi-source, the ~> seed set); per-round cost proportional to the
+    DELTA frontier's edges (ragged-arange gather), total O(E)."""
     import numpy as np
 
     reach = np.zeros(n, bool)
-    reach[src0] = True
-    delta = np.asarray([src0], np.int64)
+    srcs = np.atleast_1d(np.asarray(src0, np.int64))
+    if srcs.size == 0:
+        return reach
+    reach[srcs] = True
+    delta = srcs
     while delta.size:
         starts = indptr[delta]
         lens = indptr[delta + 1] - starts
@@ -628,11 +738,14 @@ def _fair_witness(nodes, wf, table, enabled, sub_labeled_of):
     return wit
 
 
-def _render_lasso(states, table, best, reach_adj, scc_adj):
+def _render_lasso(states, table, best, reach_adj, scc_adj,
+                  prefix_steps=None):
     """Prefix + witness-visiting cycle for a refuted verdict (the
-    rendering block shared by both check paths)."""
+    rendering block shared by both check paths).  ``prefix_steps``
+    overrides the default root->entry search (the ~> two-leg prefix)."""
     nodes, wit, entry = best
-    prefix_steps = _path(reach_adj, 0, {entry}) or []
+    if prefix_steps is None:
+        prefix_steps = _path(reach_adj, 0, {entry}) or []
     prefix = [(None, states[0])] + [
         (table[a].label(), states[v]) for a, v in prefix_steps]
     cycle = []
@@ -656,27 +769,34 @@ def _render_lasso(states, table, best, reach_adj, scc_adj):
     return cycle, prefix
 
 
-def _check_csr(config, prop, wf, states, edges, enabled, n,
+def _check_csr(config, pspec, wf, states, edges, enabled, n,
                n_edges) -> LivenessResult:
     """The array fast path of :func:`check` for CSR graph exports
     (liveness at 1e7-1e8-state scale — VERDICT r3's 5-server gap): C++
-    Tarjan SCC over the ~P-restricted CSR (utils/native.scc_csr),
+    Tarjan SCC over the target-restricted CSR (utils/native.scc_csr),
     vectorized reachability and stutter/singleton filtering; only
     nontrivial candidate SCCs (size >= 2 or self-loop, intersecting the
     reachable region) enter the per-node Python witness search, whose
     semantics are shared with the list path (_fair_witness)."""
     import numpy as np
 
-    form, pred = PROPERTIES[prop]
+    form = pspec.form
+    prop = pspec.text
     bounds = config.bounds
     table = S.action_table(bounds, config.spec)
     indptr = edges._indptr
     aidx = edges._aidx
     vidx = edges._vidx.astype(np.int64, copy=False)
-    p_mask = np.asarray(
-        states.mask(prop) if isinstance(states, StatesView)
-        else [pred(s, bounds) for s in states], bool)
-    allowed = ~p_mask
+
+    def _mask(pred_name):
+        if isinstance(states, StatesView):
+            return np.asarray(states.mask(pred_name), bool)
+        fn = PREDICATES[pred_name][0]
+        return np.asarray([fn(s, bounds) for s in states], bool)
+
+    p_mask = _mask(pspec.pred_names[0])
+    tgt_mask = _mask(pspec.pred_names[1]) if form == LEADS_TO else p_mask
+    allowed = ~tgt_mask
 
     src = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
     keep = allowed[src] & allowed[vidx]
@@ -694,15 +814,20 @@ def _check_csr(config, prop, wf, states, edges, enabled, n,
         s0, e0 = int(indptr2[u]), int(indptr2[u + 1])
         return list(zip(a2[s0:e0].tolist(), dst2[s0:e0].tolist()))
 
+    seeds = None
     if form == EVENTUALLY:
         reach_ok = bool(allowed[0])
         reach = _csr_reach(indptr2, dst2, 0, n) if reach_ok \
             else np.zeros(n, bool)
         reach_adj = _LazyAdj(indptr2, a2, dst2)
-    else:
-        reach_ok = True
+    elif form == INFINITELY_OFTEN:
         reach = _csr_reach(indptr, vidx, 0, n)
         reach_adj = _LazyAdj(indptr, aidx, vidx)
+    else:                                           # LEADS_TO
+        full = _csr_reach(indptr, vidx, 0, n)
+        seeds = np.nonzero(full & p_mask & allowed)[0]
+        reach = _csr_reach(indptr2, dst2, seeds, n)
+        reach_adj = _LazyAdj(indptr2, a2, dst2)
 
     cand_nodes = reach & allowed
     n_checked = 0
@@ -769,8 +894,13 @@ def _check_csr(config, prop, wf, states, edges, enabled, n,
     in_scc = np.zeros(n, bool)
     in_scc[best[0]] = True
     scc_adj = _LazyAdj(indptr2, a2, dst2, dst_ok=lambda v: in_scc[v])
+    prefix_steps = None
+    if form == LEADS_TO:
+        prefix_steps = _leadsto_prefix(
+            _LazyAdj(indptr, aidx, vidx), reach_adj, seeds.tolist(),
+            best[2])
     cycle, prefix = _render_lasso(states, table, best, reach_adj,
-                                  scc_adj)
+                                  scc_adj, prefix_steps=prefix_steps)
     violation = LassoViolation(prop=prop, prefix=prefix, cycle=cycle)
     return LivenessResult(prop=prop, holds=False, violation=violation,
                           n_states=n, n_edges=n_edges,
@@ -788,7 +918,8 @@ def check(config: CheckConfig, prop: str,
     prebuilt :func:`explore_graph` result so several properties can share
     one (dominant-cost) exploration.
     """
-    form, pred = PROPERTIES[prop]
+    pspec = parse_property(prop)
+    form = pspec.form
     bounds = config.bounds
     table = S.action_table(bounds, config.spec)
     for fam in wf:
@@ -804,13 +935,20 @@ def check(config: CheckConfig, prop: str,
     if hasattr(edges, "_indptr"):
         # CSR graph export (ddd_graph): the array fast path — C++ SCC,
         # vectorized reach/stutter, Python only on nontrivial SCCs
-        return _check_csr(config, prop, wf, states, edges, enabled, n,
+        return _check_csr(config, pspec, wf, states, edges, enabled, n,
                           n_edges)
-    p_mask = states.mask(prop) if isinstance(states, StatesView) \
-        else [pred(s, bounds) for s in states]
 
-    # The candidate cycle region: ~P states; edges must stay inside it.
-    allowed = [not p for p in p_mask]
+    def _mask(pred_name):
+        if isinstance(states, StatesView):
+            return states.mask(pred_name)
+        fn = PREDICATES[pred_name][0]
+        return [fn(s, bounds) for s in states]
+
+    # The candidate cycle region: ~target states (target = P for <>P /
+    # []<>P, Q for P ~> Q); cycle edges must stay inside it.
+    p_mask = _mask(pspec.pred_names[0])
+    tgt_mask = _mask(pspec.pred_names[1]) if form == LEADS_TO else p_mask
+    allowed = [not p for p in tgt_mask]
     # one edges[u] materialization per node (CSR exports rebuild the
     # tuple list per access); sub derives from sub_labeled
     sub_labeled = [[(a, v) for a, v in edges[u] if allowed[v]]
@@ -849,27 +987,35 @@ def check(config: CheckConfig, prop: str,
             wit[fam] = found
         return wit
 
-    # Reachability of the lasso's loop node: for <>P the whole prefix must
-    # avoid P; for []<>P any path does.
-    if form == EVENTUALLY:
-        reach_adj = sub_labeled if allowed[0] else [[]] * n
-        reachable_ok = allowed[0]
-    else:
-        reach_adj = edges
-        reachable_ok = True
-
-    reach = set()
-    if reachable_ok:
-        reach.add(0)
-        frontier = [0]
+    def _bfs(adj, srcs):
+        seen = set(srcs)
+        frontier = list(srcs)
         while frontier:
             nxt = []
             for u in frontier:
-                for _a, v in reach_adj[u]:
-                    if v not in reach:
-                        reach.add(v)
+                for _a, v in adj[u]:
+                    if v not in seen:
+                        seen.add(v)
                         nxt.append(v)
             frontier = nxt
+        return seen
+
+    # Reachability of the lasso's loop node: for <>P the whole prefix
+    # must avoid P; for []<>P any path does; for P ~> Q the lasso must
+    # be reachable from some (reachable) P-state through ~Q states only
+    # — the suffix after that P occurrence never meets Q.
+    seeds = None
+    if form == EVENTUALLY:
+        reach_adj = sub_labeled if allowed[0] else [[]] * n
+        reach = _bfs(sub_labeled, [0] if allowed[0] else [])
+    elif form == INFINITELY_OFTEN:
+        reach_adj = edges
+        reach = _bfs(edges, [0])
+    else:                                           # LEADS_TO
+        full = _bfs(edges, [0])
+        seeds = sorted(u for u in full if p_mask[u] and allowed[u])
+        reach = _bfs(sub_labeled, seeds)
+        reach_adj = sub_labeled     # prefix rendered in two legs below
 
     def stutter_witness(u: int) -> dict | None:
         """Pure stutter at u: fair iff every wf family is disabled there."""
@@ -925,8 +1071,10 @@ def check(config: CheckConfig, prop: str,
     # strictly inside the SCC (strong connectivity guarantees the legs).
     scc_adj = [[(a, v) for a, v in sub_labeled[u] if v in node_set]
                if u in node_set else [] for u in range(n)]
+    prefix_steps = _leadsto_prefix(edges, sub_labeled, seeds, entry) \
+        if form == LEADS_TO else None
     cycle, prefix = _render_lasso(states, table, best, reach_adj,
-                                  scc_adj)
+                                  scc_adj, prefix_steps=prefix_steps)
     violation = LassoViolation(prop=prop, prefix=prefix, cycle=cycle)
     return LivenessResult(prop=prop, holds=False, violation=violation,
                           n_states=n, n_edges=n_edges,

@@ -115,7 +115,12 @@ def parse_cfg(text: str) -> TLCConfig:
         elif mode in ("INVARIANT", "INVARIANTS"):
             cfg.invariants.extend(line.split())
         elif mode in ("PROPERTY", "PROPERTIES"):
-            cfg.properties.extend(line.split())
+            # temporal FORMULAS (<>P, []<>P, P ~> Q) are one property
+            # per line; bare names may share a line like INVARIANTS
+            if "<>" in line or "~>" in line:
+                cfg.properties.append(" ".join(line.split()))
+            else:
+                cfg.properties.extend(line.split())
         elif mode in ("CONSTRAINT", "CONSTRAINTS"):
             cfg.constraints.extend(line.split())
         elif mode == "SYMMETRY":

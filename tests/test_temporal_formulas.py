@@ -1,0 +1,144 @@
+"""Cfg-driven temporal properties (VERDICT r4 missing #4 / next #6).
+
+TLC checks arbitrary PROPERTY formulas; the checker now routes the
+three decidable-by-lasso shapes — ``<>P``, ``[]<>P``, ``P ~> Q`` over
+the registered predicate set — from a cfg PROPERTY stanza (or
+``--property``) through models/liveness, on both the list path and the
+CSR fast path, and emits the matching temporal formula + fairness twin
+spec in the --emit-tlc artifact.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.models import liveness, spec as S
+
+ELECTION = CheckConfig(
+    bounds=Bounds(n_servers=2, n_values=1, max_term=2, max_log=0,
+                  max_msgs=2),
+    spec="election", invariants=(), chunk=256)
+
+FULL = CheckConfig(
+    bounds=Bounds(n_servers=2, n_values=1, max_term=2, max_log=0,
+                  max_msgs=2),
+    spec="full", invariants=(), chunk=256)
+
+
+def test_parse_property_shapes():
+    cases = [
+        ("<>SomeLeader", liveness.EVENTUALLY, ("SomeLeader",)),
+        ("[]<>SomeLeader", liveness.INFINITELY_OFTEN, ("SomeLeader",)),
+        ("SomeCandidate ~> SomeLeader", liveness.LEADS_TO,
+         ("SomeCandidate", "SomeLeader")),
+        ("SomeCandidate~>SomeLeader", liveness.LEADS_TO,
+         ("SomeCandidate", "SomeLeader")),
+        ("EventuallyLeader", liveness.EVENTUALLY, ("SomeLeader",)),
+        ("InfinitelyOftenLeader", liveness.INFINITELY_OFTEN,
+         ("SomeLeader",)),
+    ]
+    for text, form, preds in cases:
+        ps = liveness.parse_property(text)
+        assert (ps.form, ps.pred_names) == (form, preds), text
+
+
+def test_parse_property_rejects():
+    for bad in ("<>NoSuchPred", "Bogus", "~> SomeLeader",
+                "SomeLeader ~>", "[]SomeLeader", "<>",
+                "SomeLeader ~> NoSuchPred"):
+        with pytest.raises(ValueError):
+            liveness.parse_property(bad)
+
+
+def test_formula_equals_named_property():
+    g = liveness.explore_graph(ELECTION)
+    for formula, named in (("<>SomeLeader", "EventuallyLeader"),
+                           ("[]<>SomeLeader", "InfinitelyOftenLeader")):
+        for wf in ((), ("Next",)):
+            rf = liveness.check(ELECTION, formula, wf=wf, graph=g)
+            rn = liveness.check(ELECTION, named, wf=wf, graph=g)
+            assert rf.holds == rn.holds
+            assert rf.n_sccs_checked == rn.n_sccs_checked
+
+
+def test_leads_to_verdicts_and_lasso():
+    g = liveness.explore_graph(FULL)
+    # Candidate ~> Leader holds under WF(Next)? No: the crash-loop
+    # (Restart forever) is a fair lasso that never elects.
+    r = liveness.check(FULL, "SomeCandidate ~> SomeLeader",
+                       wf=("Next",), graph=g)
+    assert not r.holds
+    v = r.violation
+    # the P occurrence is on the prefix; the cycle never satisfies Q
+    assert any(any(x == S.CANDIDATE for x in s.role)
+               for _l, s in v.prefix)
+    assert all(not any(x == S.LEADER for x in s.role)
+               for _l, s in v.cycle)
+    # stuttering refutes it with no fairness at all
+    r0 = liveness.check(FULL, "SomeCandidate ~> SomeLeader", wf=(),
+                        graph=g)
+    assert not r0.holds
+    # vacuous holds: a predicate that never fires on this spec
+    rv = liveness.check(ELECTION, "SomeCommit ~> SomeLeader",
+                        wf=(), graph=liveness.explore_graph(ELECTION))
+    assert rv.holds
+
+
+def test_leads_to_list_vs_csr_parity():
+    g_int = liveness.explore_graph(ELECTION)
+    g_ddd = liveness.ddd_graph(ELECTION)
+    for prop in ("SomeCandidate ~> SomeLeader", "<>SomeCommit",
+                 "[]<>SomeLeader"):
+        for wf in ((), ("Next",), ("Timeout", "BecomeLeader")):
+            ri = liveness.check(ELECTION, prop, wf=wf, graph=g_int)
+            rd = liveness.check(ELECTION, prop, wf=wf, graph=g_ddd)
+            assert ri.holds == rd.holds, (prop, wf)
+
+
+def test_cfg_property_formula_end_to_end(tmp_path):
+    """TLC-grammar cfg stanza -> checker verdict, through the CLI."""
+    cfg = tmp_path / "m.cfg"
+    cfg.write_text(
+        "CONSTANTS\n"
+        "    Server = {s1, s2}\n"
+        "    Value = {v1}\n"
+        "    Nil = Nil\n"
+        "PROPERTY SomeCandidate ~> SomeLeader\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "raft_tla_tpu.check", "--cpu", str(cfg),
+         "--spec", "full", "--max-term", "2", "--max-log", "0",
+         "--max-msgs", "2", "--engine", "ref", "--wf", "Next"],
+        capture_output=True, text=True, timeout=900)
+    assert "SomeCandidate ~> SomeLeader" in out.stdout
+    assert "is violated" in out.stdout          # crash-loop refutes
+    assert out.returncode == 13                 # TLC liveness exit code
+
+
+def test_emit_tlc_temporal_twin(tmp_path):
+    from raft_tla_tpu.models import tla_export
+    tla, cfgp = tla_export.export(
+        str(tmp_path), ELECTION.bounds, (), spec="election",
+        properties=("SomeCandidate ~> SomeLeader", "EventuallyLeader"),
+        wf=("Next",))
+    module = open(tla).read()
+    cfg = open(cfgp).read()
+    assert ("TemporalProp1 == (\\E i \\in Server : state[i] = "
+            "Candidate) ~> (\\E i \\in Server : state[i] = Leader)"
+            in module)
+    assert ("EventuallyLeader == <>(\\E i \\in Server : state[i] = "
+            "Leader)" in module)
+    assert "FairSpec == ElectionSpec /\\ WF_vars(ElectionNext)" in module
+    assert "SPECIFICATION FairSpec" in cfg
+    assert "PROPERTY TemporalProp1" in cfg
+    assert "PROPERTY EventuallyLeader" in cfg
+    # stock TLC rejects VIEW for temporal checking: the twin omits it
+    assert "VIEW" not in cfg
+    # family fairness spells out the existential closure
+    module2 = tla_export.emit_module(
+        FULL.bounds, (), spec="full", properties=("<>SomeLeader",),
+        wf=("Timeout", "RequestVote"))
+    assert ("FairSpec == Spec /\\ WF_vars(\\E i \\in Server : "
+            "Timeout(i)) /\\ WF_vars(\\E i, j \\in Server : "
+            "RequestVote(i, j))" in module2)
