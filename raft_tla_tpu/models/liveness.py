@@ -479,26 +479,51 @@ def ddd_graph(config: CheckConfig, caps=None):
     constore.close()
     keystore.close()
 
-    step = jax.jit(kernels.build_step(bounds, cfg.spec, (),
-                                      cfg.symmetry, view=cfg.view))
+    # Export program (VERDICT r4 weak #4: the re-expansion was the
+    # liveness wall, ~400x the SCC check).  Two structural changes over
+    # the naive per-chunk loop:
+    #   1. only (valid, fp) are fetched, so XLA dead-code-eliminates
+    #      the step's successor-row packing and constraint lanes
+    #      (measured 1.17x per-chunk on CPU, runs/export_anatomy.py);
+    #   2. K chunks run in ONE dispatch via lax.map, and segment s+1 is
+    #      dispatched before s is harvested — per-dispatch cost (the
+    #      tunnel's ~112 ms round-trip floor dominates 1024-row chunks
+    #      on the chip) amortizes K-fold and overlaps host assembly.
+    raw_step = kernels.build_step(bounds, cfg.spec, (), cfg.symmetry,
+                                  view=cfg.view)
+    # clamp by n: a sub-SB graph must not pad every dispatch to 64 chunks
+    K = max(1, min((1 << 16) // B, -(-n // B)))
+    SB = K * B
+    seg_step = jax.jit(lambda vs: jax.lax.map(
+        lambda v: (lambda o: (o["valid"], o["fp_hi"], o["fp_lo"]))(
+            raw_step(v)), vs))
     fams = sorted({inst.family for inst in table})
     fam_idx = np.asarray([fams.index(inst.family) for inst in table],
                          np.int32)
 
+    def dispatch(s0):
+        ns = min(SB, n - s0)
+        vecs = schema.unpack(host.read(s0, ns), np)
+        if ns < SB:
+            vecs = np.concatenate(
+                [vecs, np.broadcast_to(vecs[:1],
+                                       (SB - ns, vecs.shape[1]))])
+        return seg_step(jnp.asarray(vecs).reshape(K, B, vecs.shape[1]))
+
     def chunks():
-        for c0 in range(0, n, B):
-            nb = min(B, n - c0)
-            vecs = schema.unpack(host.read(c0, nb), np)
-            if nb < B:
-                vecs = np.concatenate(
-                    [vecs,
-                     np.broadcast_to(vecs[:1], (B - nb, vecs.shape[1]))])
-            out = step(jnp.asarray(vecs))
-            valid = np.asarray(out["valid"])[:nb]
-            skeys = keyset.pack_keys(
-                np.asarray(out["fp_hi"])[:nb].reshape(nb, A),
-                np.asarray(out["fp_lo"])[:nb].reshape(nb, A))
-            yield c0, valid, skeys
+        pending = dispatch(0)
+        for s0 in range(0, n, SB):
+            nxt = dispatch(s0 + SB) if s0 + SB < n else None
+            va, fh, fl = (np.asarray(x) for x in pending)  # sync here
+            pending = nxt
+            for k in range(K):
+                c0 = s0 + k * B
+                if c0 >= n:
+                    break
+                nb = min(B, n - c0)
+                yield c0, va[k][:nb], keyset.pack_keys(
+                    fh[k][:nb].reshape(nb, A),
+                    fl[k][:nb].reshape(nb, A))
 
     edges, enabled = _csr_export(
         n, sorted_keys, order, expanded, fams, fam_idx, chunks(),

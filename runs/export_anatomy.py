@@ -1,0 +1,94 @@
+"""VERDICT r4 weak #4 / next #7: where does the liveness graph export
+spend its time?  Reproduces ddd_graph's re-expansion loop with per-phase
+timers on the 3-server election SYMMETRY quotient (23,902 orbits)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+if "--tpu" not in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.ddd_engine import DDDEngine
+from raft_tla_tpu.models import spec as S
+from raft_tla_tpu.ops import kernels
+from raft_tla_tpu.utils import keyset
+
+config = CheckConfig(
+    bounds=Bounds(n_servers=3, n_values=1, max_term=2, max_log=0,
+                  max_msgs=1),
+    spec="election", invariants=(), symmetry=("Server",), chunk=1024)
+
+t0 = time.monotonic()
+eng = DDDEngine(config)
+eng.check(retain_store=True)
+host, constore, keystore, n = eng.retained
+t_bfs = time.monotonic() - t0
+print(f"BFS: {n} orbits in {t_bfs:.2f}s ({n / t_bfs:,.0f}/s)")
+
+bounds, lay, schema, table = config.bounds, eng.lay, eng.schema, eng.table
+A, B = eng.A, config.chunk
+kw = keystore.read(0, n).view(np.uint32)
+keys = keyset.pack_keys(kw[:, 1], kw[:, 0])
+order = np.argsort(keys)
+sorted_keys = keys[order]
+expanded = constore.read(0, n)[:, 0].astype(bool)
+
+step = jax.jit(kernels.build_step(bounds, config.spec, (),
+                                  config.symmetry, view=config.view))
+
+T = dict(read=0.0, unpack=0.0, dispatch=0.0, harvest=0.0, pack=0.0,
+         assemble=0.0)
+t_all = time.monotonic()
+e_cnt = 0
+for c0 in range(0, n, B):
+    nb = min(B, n - c0)
+    t = time.monotonic(); rows = host.read(c0, nb); T["read"] += time.monotonic() - t
+    t = time.monotonic()
+    vecs = schema.unpack(rows, np)
+    if nb < B:
+        vecs = np.concatenate(
+            [vecs, np.broadcast_to(vecs[:1], (B - nb, vecs.shape[1]))])
+    T["unpack"] += time.monotonic() - t
+    t = time.monotonic()
+    out = step(jnp.asarray(vecs))
+    jax.block_until_ready(out["valid"])
+    T["dispatch"] += time.monotonic() - t
+    t = time.monotonic()
+    valid = np.asarray(out["valid"])[:nb]
+    fph = np.asarray(out["fp_hi"])[:nb].reshape(nb, A)
+    fpl = np.asarray(out["fp_lo"])[:nb].reshape(nb, A)
+    T["harvest"] += time.monotonic() - t
+    t = time.monotonic()
+    skeys = keyset.pack_keys(fph, fpl)
+    T["pack"] += time.monotonic() - t
+    t = time.monotonic()
+    b_idx, a_idx = np.nonzero(valid)
+    u_idx = (c0 + b_idx).astype(np.int64)
+    m = expanded[u_idx]
+    sk = skeys[b_idx[m], a_idx[m]]
+    pos = np.searchsorted(sorted_keys, sk)
+    e_cnt += sk.size
+    T["assemble"] += time.monotonic() - t
+wall = time.monotonic() - t_all
+print(f"export loop: {n} orbits, {e_cnt} edges in {wall:.2f}s "
+      f"({n / wall:,.0f} orbits/s)")
+for k, v in sorted(T.items(), key=lambda kv: -kv[1]):
+    print(f"  {k:9} {v:7.2f}s  {100 * v / wall:5.1f}%")
+host.close(); constore.close(); keystore.close()
+
+# -- the restructured ddd_graph export, end to end --------------------
+import dataclasses as _dc
+from raft_tla_tpu.models import liveness
+t1 = time.monotonic()
+states, edges, enabled, expanded2 = liveness.ddd_graph(config)
+t_new = time.monotonic() - t1
+print(f"ddd_graph (segmented slim export, incl. its own BFS): "
+      f"{len(states)} orbits, {edges.n_edges} edges in {t_new:.2f}s")
+states.close()
