@@ -484,10 +484,9 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     _DEVICE_ENGINES = ("device", "paged", "streamed", "ddd", "shard",
                        "pagedshard", "ddd-shard")
-    if args.view and (args.property or args.simulate):
-        p.error("--view composes with the exhaustive safety engines "
-                "only; liveness graphs and simulation replay key states "
-                "unviewed — run those without --view")
+    if args.view and args.simulate:
+        p.error("--view does not compose with --simulate (random walks "
+                "replay concrete states; a view only folds dedup keys)")
     if args.reshard_cap and not (args.reshard_to and
                                  args.engine == "shard"):
         p.error("--reshard-cap only applies to --reshard-to with "
@@ -528,11 +527,10 @@ def main(argv=None) -> int:
         print(f"Symmetry: {' x '.join(config.symmetry)} permutations "
               "(counting orbits)")
     if config.view:
-        if props:
-            print(f"Error: PROPERTY {list(props)} cannot be checked "
-                  "under --view (liveness graphs key states unviewed)",
-                  file=sys.stderr)
-            return EXIT_ERROR
+        # registered views are EXACT (bisimulations, models/views.py),
+        # so the view quotient is transition-faithful and liveness on
+        # it is sound for the view-invariant registered predicates —
+        # see the lift argument in liveness.ddd_graph
         print(f"View: {config.view} (counting view-quotient states)")
 
     if args.emit_tlc:
@@ -707,10 +705,10 @@ def _check_liveness(args, config, props) -> int:
     # ceiling); other device engines keep the device_engine export; host
     # engines use the interpreter.
     try:
-        if args.engine in ("host", "ref"):
+        if args.engine in ("host", "ref") and not config.view:
             graph = liveness.explore_graph(config)
-        elif config.symmetry or args.engine in ("ddd", "ddd-shard",
-                                                "streamed"):
+        elif config.view or config.symmetry or args.engine in (
+                "ddd", "ddd-shard", "streamed"):
             from raft_tla_tpu.ddd_engine import DDDCapacities
             from raft_tla_tpu.models import spec as S
             if config.symmetry:

@@ -226,3 +226,37 @@ def test_mesh_engine_under_view():
     assert got.n_states == ref.n_states
     assert got.levels == ref.levels
     assert got.n_transitions == ref.n_transitions
+
+def test_predicates_view_invariant():
+    """The PREDICATES registry's obligation #2 (models/liveness.py):
+    every registered temporal predicate must read only view-preserved
+    fields, for every registered view — pred(s) == pred(view(s)) over a
+    reachable full-spec corpus.  A future predicate that reads vote
+    sets (legal for symmetry, unsound under deadvotes) fails here
+    loudly instead of silently mis-evaluating on the quotient."""
+    from raft_tla_tpu.models import interp, liveness, views
+
+    b = Bounds(n_servers=2, n_values=1, max_term=2, max_log=0,
+               max_msgs=2)
+    cfg = CheckConfig(bounds=b, spec="full", invariants=())
+    # reachable corpus: the whole bounded 2-server full-spec space
+    seen = {interp.init_state(b)}
+    frontier = [interp.init_state(b)]
+    while frontier:
+        nxt = []
+        for s in frontier:
+            if not interp.constraint_ok(s, b):
+                continue
+            for _i, t in interp.successors(s, b, spec="full"):
+                if t not in seen:
+                    seen.add(t)
+                    nxt.append(t)
+        frontier = nxt
+    assert len(seen) > 20000            # the corpus is the real space
+    for vname in views.REGISTRY:
+        vw = views.py_view(vname)
+        # the view must move SOME state or the check is vacuous
+        assert any(vw(s, b) != s for s in seen)
+        for pname, (pred, _struct, _tla) in liveness.PREDICATES.items():
+            bad = [s for s in seen if pred(s, b) != pred(vw(s, b), b)]
+            assert not bad, (vname, pname, bad[:1])
