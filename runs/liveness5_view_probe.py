@@ -1,0 +1,42 @@
+"""VERDICT r4 missing #5: the 5-server liveness composition, measured.
+
+The r4 probe (runs/liveness5_probe.out) measured the plain SYMMETRY
+quotient at 5s/t2/m1: 527k orbits by ~L20, still x2-3 per level —
+infeasible for the exact graph checker.  This composes the deadvotes
+VIEW (exact bisimulation, liveness-sound since round 5) on top of
+SYMMETRY and measures the level growth it actually buys, same bounds,
+same deadline protocol.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+if "--tpu" not in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+
+CFG = CheckConfig(
+    bounds=Bounds(n_servers=5, n_values=2, max_term=2, max_log=0,
+                  max_msgs=1, max_dup=1),
+    spec="election", invariants=(), symmetry=("Server",),
+    view="deadvotes", chunk=1024)
+
+deadline = float(sys.argv[1]) if len(sys.argv) > 1 and \
+    not sys.argv[1].startswith("--") else 1200.0
+eng = DDDEngine(CFG, DDDCapacities(block=1 << 16, table=1 << 20,
+                                   seg_rows=1 << 17, flush=1 << 18,
+                                   levels=256, retention="frontier"))
+r = eng.check(deadline_s=deadline,
+              on_progress=lambda s: print(json.dumps(
+                  {k: s[k] for k in ("wall_s", "n_states", "level")}),
+                  flush=True))
+print(json.dumps({"final": r.n_states, "levels": r.levels,
+                  "complete": r.complete, "wall_s": round(r.wall_s, 1)}))
