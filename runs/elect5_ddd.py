@@ -6,7 +6,7 @@ The streamed-engine v3 run reached 131.3M orbits into level 26 before the
 did not survive the environment reset.  This restarts the space on the
 DDD engine, whose exact dedup lives in host RAM (~15B-state capacity).
 
-Usage: python runs/elect5_ddd.py [resume] [--route K]
+Usage: python runs/elect5_ddd.py [resume] [--route K] [--cpu]
 Checkpoints at runs/elect5ddd.ckpt every 15 min; stats stream appended to
 runs/elect5ddd.stats (one JSON line per flush/level).  ``--route K``
 switches to the EP-routed step (DDDCapacities.route_rows=K) —
@@ -46,11 +46,17 @@ CAPS = DDDCapacities(block=1 << 20, table=1 << 22, seg_rows=1 << 19,
 
 def main():
     args = sys.argv[1:]
+    if "--cpu" in args:          # resume-path validation without a chip
+        import argparse
+
+        from raft_tla_tpu.check import _force_cpu
+        _force_cpu(argparse.Namespace(cpu=True, devices=0))
+        args.remove("--cpu")
     route = 0
     if "--route" in args:
         k = args.index("--route")
         if k + 1 >= len(args) or not args[k + 1].isdigit():
-            sys.exit("usage: elect5_ddd.py [resume] [--route K]  "
+            sys.exit("usage: elect5_ddd.py [resume] [--route K] [--cpu]  "
                      "(K = routed candidate slots per chunk, integer)")
         route = int(args[k + 1])
         del args[k:k + 2]
