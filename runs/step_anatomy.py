@@ -53,7 +53,12 @@ INVS = ("NoTwoLeaders", "LogMatching", "CommittedWithinLog",
 init = interp.init_state(BOUNDS)
 frontier = [init]
 seen = {init}
-for _ in range(6):
+pool = []
+while len(pool) < B:        # B DISTINCT rows — a cycled pool inflates
+    if not frontier:        # the in-chunk duplicate share
+        raise SystemExit(
+            f"space exhausted below {B} distinct rows per level — "
+            "shrink B or widen BOUNDS")
     nxt = []
     for s in frontier:
         if not interp.constraint_ok(s, BOUNDS):
@@ -63,10 +68,8 @@ for _ in range(6):
                 seen.add(t)
                 nxt.append(t)
     frontier = nxt
-pool = [interp.to_vec(s, BOUNDS) for s in frontier
-        if interp.constraint_ok(s, BOUNDS)][:B] or \
-    [interp.to_vec(init, BOUNDS)]
-rows = np.stack([pool[i % len(pool)] for i in range(B)])
+    pool = [s for s in frontier if interp.constraint_ok(s, BOUNDS)]
+rows = np.stack([interp.to_vec(s, BOUNDS) for s in pool[:B]])
 vecs = jnp.asarray(rows)
 
 VARIANTS = {}
