@@ -32,6 +32,7 @@ prefixes.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -621,6 +622,26 @@ def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
 _PRESCAN_RUNGS = (4, 2)      # divisors of N, tried in order
 
 
+def _prescan_enabled(bounds, symmetry):
+    """Platform/shape gate for the prescan ladder.  The lexsort is a
+    fixed per-chunk cost while the saving scales with |G| (the scan
+    iterations skipped per deduplicated lane), and TPU sorts are slow:
+    measured on-chip (runs/prescan_ab.py, sync-timed medians), the
+    ladder is a 1.44x LOSS at |G|=6 (flagship, 117.5 vs 81.5 ms/chunk)
+    but a 1.25x win at |G|=120 (elect5, 201.7 vs 251.5 ms/chunk).  On
+    CPU it wins already at |G|=6 (2.22x, runs/step_anatomy.out)."""
+    if not _PRESCAN_RUNGS or not symmetry:
+        return False
+    if jax.default_backend() == "cpu":
+        return True
+    g = 1
+    if "Server" in symmetry:
+        g *= math.factorial(bounds.n_servers)
+    if "Value" in symmetry:
+        g *= math.factorial(bounds.n_values)
+    return g >= 120
+
+
 def _orbit_fp_prescan(orbit_fp, flat, raw_hi, raw_lo, N):
     """Orbit-scan only the first occurrence of each raw key, gather the
     canonical fingerprints back through the group map (see the
@@ -678,18 +699,22 @@ def apply_stages(bounds, stages, symmetry, succs, svecs, valid):
         flat = jax.tree.map(
             lambda a: a.reshape((-1,) + a.shape[2:]), ksuccs)
         N = valid.size
-        # raw keys hash the ALREADY-PACKED UN-VIEWED rows — deliberate:
-        # zero extra pack cost, and raw grouping only needs to REFINE
-        # canonical equality (under a view, view-equal successors that
-        # differ in view-excluded fields just occupy separate slots —
-        # less compaction, never wrong).  In-chunk raw collisions are
-        # strictly inside the globally-accepted fp-collision class;
-        # invalid lanes collapse into one all-ones sentinel group
-        rh, rl = fpr.fingerprint(svecs.reshape(N, -1), consts, jnp)
         vmask = valid.reshape(-1)
-        rh = jnp.where(vmask, rh, ~jnp.uint32(0))
-        rl = jnp.where(vmask, rl, ~jnp.uint32(0))
-        fh, fl = _orbit_fp_prescan(orbit_fp, flat, rh, rl, N)
+        if _prescan_enabled(bounds, symmetry):
+            # raw keys hash the ALREADY-PACKED UN-VIEWED rows —
+            # deliberate: zero extra pack cost, and raw grouping only
+            # needs to REFINE canonical equality (under a view,
+            # view-equal successors that differ in view-excluded fields
+            # just occupy separate slots — less compaction, never
+            # wrong).  In-chunk raw collisions are strictly inside the
+            # globally-accepted fp-collision class; invalid lanes
+            # collapse into one all-ones sentinel group
+            rh, rl = fpr.fingerprint(svecs.reshape(N, -1), consts, jnp)
+            rh = jnp.where(vmask, rh, ~jnp.uint32(0))
+            rl = jnp.where(vmask, rl, ~jnp.uint32(0))
+            fh, fl = _orbit_fp_prescan(orbit_fp, flat, rh, rl, N)
+        else:
+            fh, fl = orbit_fp(flat)
         # invalid lanes: ZERO, not whichever garbage the sentinel
         # group's rep produced — deterministic across step variants
         # (the CP per-lane parity test compares every lane)

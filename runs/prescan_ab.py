@@ -1,0 +1,92 @@
+"""On-chip A/B of the pre-orbit raw-fp prescan (runs/step_anatomy.out
+CPU-measured 2.22x) — decides whether the elect5 campaign runs with the
+prescan ladder on or off.  The lexsort at the ladder's heart is a CPU
+win but sorts are historically slow on TPU; bench_early_r5.json
+(62.1k orbits/s vs the round-4 preview's 102.6k) suggests it inverts.
+
+Builds the fused step at a given shape twice — _PRESCAN_RUNGS as
+shipped vs () (ladder collapses to the full scan; the sort is DCE'd) —
+on identical mid-depth distinct-row chunks, sync-timed (the r3/r4
+protocol: block_until_ready between reps, median of reps).
+
+Usage: python runs/prescan_ab.py [--cpu] [flagship|elect5] [reps]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+if "--cpu" in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.models import interp
+from raft_tla_tpu.ops import kernels
+
+SHAPE = "elect5" if "elect5" in sys.argv else "flagship"
+REPS = next((int(a) for a in sys.argv[1:] if a.isdigit()), 30)
+B = 4096
+if SHAPE == "flagship":
+    BOUNDS = Bounds(n_servers=3, n_values=2, max_term=2, max_log=1,
+                    max_msgs=2, max_dup=1)
+    SPEC, INVS = "full", ("NoTwoLeaders", "LogMatching",
+                          "CommittedWithinLog", "LeaderCompleteness")
+else:
+    BOUNDS = Bounds(n_servers=5, n_values=2, max_term=2, max_log=0,
+                    max_msgs=2, max_dup=1)
+    SPEC, INVS = "election", ("NoTwoLeaders", "CommittedWithinLog")
+
+init = interp.init_state(BOUNDS)
+frontier, seen, pool = [init], {init}, []
+while len(pool) < B:
+    nxt = []
+    for s in frontier:
+        if not interp.constraint_ok(s, BOUNDS):
+            continue
+        for _i, t in interp.successors(s, BOUNDS, spec=SPEC):
+            if t not in seen:
+                seen.add(t)
+                nxt.append(t)
+    frontier = nxt
+    pool = [s for s in frontier if interp.constraint_ok(s, BOUNDS)]
+rows = np.stack([interp.to_vec(s, BOUNDS) for s in pool[:B]])
+vecs = jnp.asarray(rows)
+
+out = {}
+for name, rungs in (("prescan", kernels._PRESCAN_RUNGS), ("off", ())):
+    saved = kernels._PRESCAN_RUNGS
+    kernels._PRESCAN_RUNGS = rungs
+    try:
+        fn = jax.jit(kernels.build_step(BOUNDS, SPEC, INVS, ("Server",)))
+        r = fn(vecs)
+        jax.block_until_ready(r)
+    finally:
+        kernels._PRESCAN_RUNGS = saved
+    # parity across variants while we're here — same fps bit-for-bit
+    if name == "prescan":
+        ref_fp = (np.asarray(r["fp_hi"]), np.asarray(r["fp_lo"]))
+    else:
+        assert np.array_equal(np.asarray(r["fp_hi"]), ref_fp[0])
+        assert np.array_equal(np.asarray(r["fp_lo"]), ref_fp[1])
+    times = []
+    for _ in range(REPS):
+        t0 = time.monotonic()
+        jax.block_until_ready(fn(vecs))
+        times.append(time.monotonic() - t0)
+    med = sorted(times)[len(times) // 2]
+    out[name] = med
+    print(f"{name:8} {med * 1e3:8.2f} ms/chunk ({B / med:9,.0f} rows/s)",
+          flush=True)
+
+print(json.dumps({
+    "platform": jax.devices()[0].platform, "shape": SHAPE, "chunk": B,
+    "reps": REPS, "ms_prescan": round(out["prescan"] * 1e3, 2),
+    "ms_off": round(out["off"] * 1e3, 2),
+    "speedup_from_prescan": round(out["off"] / out["prescan"], 3)}))
