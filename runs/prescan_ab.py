@@ -4,10 +4,11 @@ prescan ladder on or off.  The lexsort at the ladder's heart is a CPU
 win but sorts are historically slow on TPU; bench_early_r5.json
 (62.1k orbits/s vs the round-4 preview's 102.6k) suggests it inverts.
 
-Builds the fused step at a given shape twice — _PRESCAN_RUNGS as
-shipped vs () (ladder collapses to the full scan; the sort is DCE'd) —
-on identical mid-depth distinct-row chunks, sync-timed (the r3/r4
-protocol: block_until_ready between reps, median of reps).
+Builds the fused step at a given shape twice — the _prescan_enabled
+gate forced True vs forced False (the harness measures the comparison
+the gate encodes, so it must bypass the gate itself) — on identical
+mid-depth distinct-row chunks, sync-timed (the r3/r4 protocol:
+block_until_ready between reps, median of reps).
 
 Usage: python runs/prescan_ab.py [--cpu] [flagship|elect5] [reps]
 """
@@ -46,6 +47,10 @@ else:
 init = interp.init_state(BOUNDS)
 frontier, seen, pool = [init], {init}, []
 while len(pool) < B:
+    if not frontier:
+        raise SystemExit(
+            f"space exhausted below {B} distinct rows per level — "
+            "shrink B or widen BOUNDS")
     nxt = []
     for s in frontier:
         if not interp.constraint_ok(s, BOUNDS):
@@ -60,15 +65,19 @@ rows = np.stack([interp.to_vec(s, BOUNDS) for s in pool[:B]])
 vecs = jnp.asarray(rows)
 
 out = {}
-for name, rungs in (("prescan", kernels._PRESCAN_RUNGS), ("off", ())):
-    saved = kernels._PRESCAN_RUNGS
-    kernels._PRESCAN_RUNGS = rungs
+# force each arm PAST the _prescan_enabled platform/shape gate — the
+# harness exists to measure the comparison the gate encodes, so it
+# must not be subject to it
+for name, gate in (("prescan", lambda *_: True),
+                   ("off", lambda *_: False)):
+    saved = kernels._prescan_enabled
+    kernels._prescan_enabled = gate
     try:
         fn = jax.jit(kernels.build_step(BOUNDS, SPEC, INVS, ("Server",)))
         r = fn(vecs)
         jax.block_until_ready(r)
     finally:
-        kernels._PRESCAN_RUNGS = saved
+        kernels._prescan_enabled = saved
     # parity across variants while we're here — same fps bit-for-bit
     if name == "prescan":
         ref_fp = (np.asarray(r["fp_hi"]), np.asarray(r["fp_lo"]))
