@@ -52,6 +52,11 @@ def main():
         from raft_tla_tpu.check import _force_cpu
         _force_cpu(argparse.Namespace(cpu=True, devices=0))
         args.remove("--cpu")
+    if "--seg-rows" in args:     # checkpoint-compatible dispatch sizing
+        k = args.index("--seg-rows")
+        global CAPS
+        CAPS = dataclasses.replace(CAPS, seg_rows=1 << int(args[k + 1]))
+        del args[k:k + 2]
     route = 0
     if "--route" in args:
         k = args.index("--route")
