@@ -632,6 +632,12 @@ def _prescan_enabled(bounds, symmetry):
     CPU it wins already at |G|=6 (2.22x, runs/step_anatomy.out)."""
     if not _PRESCAN_RUNGS or not symmetry:
         return False
+    import os
+    force = os.environ.get("RAFT_TLA_PRESCAN", "auto")
+    if force == "on":            # measurement override (runs/prescan_ab,
+        return True              # in-engine bench A/B) — not for prod
+    if force == "off":
+        return False
     if jax.default_backend() == "cpu":
         return True
     g = 1

@@ -6,7 +6,8 @@ The streamed-engine v3 run reached 131.3M orbits into level 26 before the
 did not survive the environment reset.  This restarts the space on the
 DDD engine, whose exact dedup lives in host RAM (~15B-state capacity).
 
-Usage: python runs/elect5_ddd.py [resume] [--route K] [--cpu]
+Usage: python runs/elect5_ddd.py [resume] [--seg-rows E] [--route K] [--cpu]
+(--seg-rows E sets DDDCapacities.seg_rows = 2**E -- checkpoint-compatible.)
 Checkpoints at runs/elect5ddd.ckpt every 15 min; stats stream appended to
 runs/elect5ddd.stats (one JSON line per flush/level).  ``--route K``
 switches to the EP-routed step (DDDCapacities.route_rows=K) —
@@ -54,6 +55,11 @@ def main():
         args.remove("--cpu")
     if "--seg-rows" in args:     # checkpoint-compatible dispatch sizing
         k = args.index("--seg-rows")
+        if k + 1 >= len(args) or not args[k + 1].isdigit() \
+                or not 15 <= int(args[k + 1]) <= 26:
+            sys.exit("usage: elect5_ddd.py [resume] [--seg-rows E] "
+                     "[--route K] [--cpu]  (E = log2 of the segment row "
+                     "budget, 15-26; default 19)")
         global CAPS
         CAPS = dataclasses.replace(CAPS, seg_rows=1 << int(args[k + 1]))
         del args[k:k + 2]
