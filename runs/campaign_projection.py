@@ -1,0 +1,82 @@
+"""Live projection for the elect5 campaign (BASELINE config #2).
+
+Reads runs/elect5ddd.stats (the live run) and runs/elect5ddd_r4_final.stats
+(the round-4 record: exact per-level orbit counts through L30 complete +
+L31 partial), prints the current incremental rate, the pace ratio vs the
+r4 run at the same cumulative count, and a completion projection for a
+given stop deadline.
+
+Usage: python runs/campaign_projection.py [stop_utc_HH:MM]
+"""
+import datetime
+import json
+import os
+import sys
+
+RUNS = os.path.dirname(os.path.abspath(__file__))
+
+
+def load(name):
+    out = []
+    with open(os.path.join(RUNS, name)) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def main():
+    live = load("elect5ddd.stats")
+    r4 = load("elect5ddd_r4_final.stats")
+    if not live:
+        sys.exit("no live stats yet")
+    cur = live[-1]
+    n, w, lv = cur["n_states"], cur["wall_s"], cur["level"]
+
+    # incremental rate over the last ~10 min of flushes
+    tail = [d for d in live if d["wall_s"] >= w - 600 and d["wall_s"] <= w]
+    if len(tail) >= 2:
+        inc = (tail[-1]["n_states"] - tail[0]["n_states"]) / max(
+            1e-9, tail[-1]["wall_s"] - tail[0]["wall_s"])
+    else:
+        inc = cur.get("inc_states_per_sec", 0.0)
+
+    # r4 wall at the same cumulative count (linear within flushes)
+    r4_wall = None
+    for a, b in zip(r4, r4[1:]):
+        if a["n_states"] <= n <= b["n_states"]:
+            f = (n - a["n_states"]) / max(1, b["n_states"] - a["n_states"])
+            r4_wall = a["wall_s"] + f * (b["wall_s"] - a["wall_s"])
+            break
+    pace = (r4_wall / w) if r4_wall else None
+
+    # known space landmarks from r4
+    r4_end_states = 983_412_637          # L31 partial endpoint
+    lv_sizes = {}
+    seen = {}
+    for d in r4:
+        seen[d["level"]] = d["n_states"]
+    ks = sorted(seen)
+    for i, k in enumerate(ks[1:], 1):
+        lv_sizes[k] = seen[k] - seen[ks[i - 1]]
+
+    print(f"now: L{lv}, {n:,} orbits, wall {w:,.0f}s, "
+          f"inc {inc:,.0f}/s" + (f", pace vs r4 {pace:.2f}x" if pace else ""))
+    print(f"r4 endpoint {r4_end_states:,} (L30 complete; L31 partial "
+          f"+83.4M; L30 size {lv_sizes.get(30, 0):,})")
+
+    if len(sys.argv) > 1:
+        hh, mm = map(int, sys.argv[1].split(":"))
+        now = datetime.datetime.now(datetime.timezone.utc)
+        stop = now.replace(hour=hh, minute=mm, second=0, microsecond=0)
+        if stop < now:
+            stop += datetime.timedelta(days=1)
+        left = (stop - now).total_seconds()
+        print(f"budget to {sys.argv[1]}Z: {left / 3600:.2f}h -> "
+              f"+{inc * left:,.0f} orbits at the current rate "
+              f"(endpoint ~{n + inc * left:,.0f})")
+
+
+if __name__ == "__main__":
+    main()
