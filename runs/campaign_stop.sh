@@ -1,0 +1,27 @@
+#!/bin/bash
+# Graceful elect5 campaign stop (round-5 endgame procedure).
+# SIGINT once -> the engine checkpoints at the next segment boundary and
+# exits with the endpoint JSON on stdout (runs/elect5ddd_r5b.out).
+# The r4/r5 operational traps this encodes:
+#   - never SIGKILL first (r4's kill during a wedged dispatch lost the worker
+#     for >1h);
+#   - after exit, the TPU worker claim needs ~10 min to release before any
+#     other process may touch the chip (8d92f00: 2.5 min relaunch wedged,
+#     10 min pause ran first try).
+set -u
+PID=$(pgrep -f "runs/elect5_ddd.py" | head -1)
+if [ -z "$PID" ]; then echo "no campaign process"; exit 1; fi
+echo "SIGINT -> $PID at $(date -u +%H:%M:%S)"
+kill -INT "$PID"
+for i in $(seq 1 180); do
+    kill -0 "$PID" 2>/dev/null || break
+    sleep 10
+done
+if kill -0 "$PID" 2>/dev/null; then
+    echo "still alive after 30 min; NOT escalating (wedge risk) — investigate"
+    exit 2
+fi
+echo "campaign exited at $(date -u +%H:%M:%S); endpoint tail:"
+tail -3 /root/repo/runs/elect5ddd_r5b.out
+tail -1 /root/repo/runs/elect5ddd.stats
+echo "worker-claim release pause: wait 10 min before the next chip job"
