@@ -17,12 +17,21 @@ RUNS = os.path.dirname(os.path.abspath(__file__))
 
 
 def load(name):
+    """Parse a stats stream; rebase wall_s to a cumulative clock across
+    in-file resumes (each resume resets the runner's wall_s to ~0)."""
     out = []
+    offset = prev = 0.0
     with open(os.path.join(RUNS, name)) as f:
         for line in f:
             line = line.strip()
-            if line:
-                out.append(json.loads(line))
+            if not line:
+                continue
+            d = json.loads(line)
+            if d["wall_s"] < prev:
+                offset += prev
+            prev = d["wall_s"]
+            d = dict(d, wall_s=d["wall_s"] + offset)
+            out.append(d)
     return out
 
 
