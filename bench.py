@@ -87,6 +87,111 @@ def run_one(idx: int) -> None:
     }))
 
 
+def run_fiducial() -> None:
+    """Child process: the chip-state fiducial + utilization line.
+
+    Three PINNED workloads whose times vary only with chip weather —
+    never with bench-config or gate-policy drift — so any BENCH-round
+    delta in the headline can be attributed to code vs chip:
+
+    - ``copy_512mb_ms``: host->device transfer of a fixed 512 MB int32
+      buffer (tunnel/DMA health);
+    - ``synthetic_step_ms``: the fused step at the flagship shape
+      (3s/2v t2 l1 m2, SYMMETRY Server, chunk 4096) on a fixed
+      depth<=2 row pool, orbit-scan gates FORCED off so the program is
+      bit-stable across rounds;
+    - a saturating elementwise uint32 loop measuring the chip's
+      achievable VPU word rate NOW — the denominator for
+      ``pct_vpu_peak`` (a measured ceiling, not a datasheet constant,
+      so the ratio cancels chip weather by construction).
+
+    ``words_per_sec`` is the orbit scan's analytic word traffic
+    (chunk * actions * |G| * packed width) over the synthetic step
+    time; ``pct_vpu_peak`` divides it by the measured elementwise
+    ceiling.
+    """
+    import math
+    import os
+
+    # pin the orbit-scan program: policy changes must not move the fiducial
+    os.environ["RAFT_TLA_PRESCAN"] = "off"
+    os.environ["RAFT_TLA_SIGPRUNE"] = "off"
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tla_tpu.config import Bounds
+    from raft_tla_tpu.models import interp
+    from raft_tla_tpu.models import spec as S
+    from raft_tla_tpu.ops import kernels
+    from raft_tla_tpu.ops import state as st
+
+    def _median_ms(fn, reps=5):
+        times = []
+        for _ in range(reps):
+            t0 = time.monotonic()
+            jax.block_until_ready(fn())
+            times.append(time.monotonic() - t0)
+        return sorted(times)[len(times) // 2] * 1e3
+
+    # -- fixed 512 MB host->device copy ------------------------------------
+    host = np.zeros(512 * (1 << 20) // 4, dtype=np.int32)
+    jax.block_until_ready(jax.device_put(host))          # warm the path
+    copy_ms = _median_ms(lambda: jax.device_put(host), reps=3)
+
+    # -- pinned-shape synthetic fused step ---------------------------------
+    bounds = Bounds(n_servers=3, n_values=2, max_term=2, max_log=1,
+                    max_msgs=2, max_dup=1)
+    chunk, spec = 4096, "full"
+    pool, frontier, seen = [], [interp.init_state(bounds)], set()
+    for _ in range(2):                       # fixed depth-<=2 pool
+        nxt = []
+        for s in frontier:
+            for _i, t in interp.successors(s, bounds, spec=spec):
+                if t not in seen and interp.constraint_ok(t, bounds):
+                    seen.add(t)
+                    nxt.append(t)
+        frontier = nxt
+        pool += nxt
+    rows = np.stack([interp.to_vec(s, bounds) for s in pool])
+    vecs = jnp.asarray(np.tile(rows, (-(-chunk // len(rows)), 1))[:chunk])
+    step = jax.jit(kernels.build_step(bounds, spec,
+                                      ("NoTwoLeaders", "LogMatching"),
+                                      ("Server",)))
+    jax.block_until_ready(step(vecs))                    # compile
+    step_ms = _median_ms(lambda: step(vecs))
+
+    # -- measured elementwise ceiling --------------------------------------
+    x = jnp.arange(1 << 24, dtype=jnp.uint32)            # 64 MB resident
+    iters = 64
+
+    @jax.jit
+    def vpu(v):
+        return jax.lax.fori_loop(
+            0, iters,
+            lambda _i, a: (a ^ (a * jnp.uint32(0x9E3779B1)))
+            + jnp.uint32(1), v)
+
+    jax.block_until_ready(vpu(x))                        # compile
+    vpu_ms = _median_ms(lambda: vpu(x))
+    peak_words_per_sec = (1 << 24) * iters / (vpu_ms / 1e3)
+
+    # orbit-scan analytic word traffic of the synthetic step
+    A = len(S.action_table(bounds, spec))
+    width = st.Layout.of(bounds).width
+    G = math.factorial(bounds.n_servers)
+    words_per_sec = chunk * A * G * width / (step_ms / 1e3)
+
+    print(json.dumps({
+        "copy_512mb_ms": round(copy_ms, 2),
+        "synthetic_step_ms": round(step_ms, 2),
+        "words_per_sec": round(words_per_sec, 1),
+        "pct_vpu_peak": round(100.0 * words_per_sec / peak_words_per_sec,
+                              2),
+    }))
+
+
 def run_northstar() -> None:
     """Child process: the time-boxed symmetric full-``Next`` 3s/2v probe.
 
@@ -192,6 +297,17 @@ def main() -> None:
     print(f"bench preflight: device platform "
           f"{proc.stdout.strip()!r}", file=sys.stderr)
 
+    # -- part 0.5: chip-state fiducial -------------------------------------
+    # measured FIRST and merged into _partial immediately: a later wedge
+    # still reports the chip-weather evidence the round needs
+    fid = _child(["--fiducial"], timeout=300, what="fiducial")
+    _partial.update(fid)
+    print(f"fiducial: 512MB copy {fid['copy_512mb_ms']:.1f} ms, "
+          f"synthetic step {fid['synthetic_step_ms']:.1f} ms, "
+          f"{fid['words_per_sec']:,.0f} orbit-words/s "
+          f"({fid['pct_vpu_peak']:.1f}% of measured VPU ceiling)",
+          file=sys.stderr)
+
     # -- part 1: the north-star probe --------------------------------------
     ns = _child(["--northstar"], timeout=480, what="northstar")
     if ns["violation"]:
@@ -244,6 +360,7 @@ def main() -> None:
         "projected_flagship_wall_s": round(projected_flagship_wall, 1),
         "toy_suite_states_per_sec": round(total_states / total_wall, 1),
         "toy_suite_vs_60s_budget": round(60.0 / total_wall, 2),
+        **fid,
     }))
 
 
@@ -252,5 +369,7 @@ if __name__ == "__main__":
         run_one(int(sys.argv[2]))
     elif len(sys.argv) == 2 and sys.argv[1] == "--northstar":
         run_northstar()
+    elif len(sys.argv) == 2 and sys.argv[1] == "--fiducial":
+        run_fiducial()
     else:
         main()

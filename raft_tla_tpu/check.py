@@ -194,6 +194,16 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="quotient the state space by Server permutation "
                         "symmetry (TLC SYMMETRY analog; also enabled by a "
                         "cfg SYMMETRY stanza)")
+    p.add_argument("--sig-prune", default=None,
+                   choices=("auto", "on", "off"),
+                   help="signature-refinement orbit-scan pruning: scan one "
+                        "permutation per coset of the verified per-state "
+                        "stabilizer instead of the whole group (bit-"
+                        "identical keys; ops/symmetry.py has the soundness "
+                        "argument). Sets RAFT_TLA_SIGPRUNE process-wide so "
+                        "every engine inherits one decision; default: "
+                        "leave the env/auto policy alone (auto is "
+                        "currently OFF — RESULTS.md 'sig-prune A/B')")
     p.add_argument("--stats", action="store_true",
                    help="emit one JSON line of run stats per search segment "
                         "on stderr (device/paged/shard engines)")
@@ -482,6 +492,12 @@ def _run(args, config):
 def main(argv=None) -> int:
     p = build_argparser()
     args = p.parse_args(argv)
+    if args.sig_prune is not None:
+        # Process-wide, BEFORE any step build: the gate is read at step-
+        # construction time (ops/kernels._sigprune_enabled), and liveness
+        # re-runs build engines of their own.
+        import os
+        os.environ["RAFT_TLA_SIGPRUNE"] = args.sig_prune
     _DEVICE_ENGINES = ("device", "paged", "streamed", "ddd", "shard",
                        "pagedshard", "ddd-shard")
     if args.view and args.simulate:

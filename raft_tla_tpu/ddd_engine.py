@@ -708,6 +708,10 @@ def _build_segment(config: CheckConfig, caps: DDDCapacities, A: int,
         raise ValueError(
             f"seg_rows={OCAP} must be >= per-chunk candidate rows = {NK}")
     n_inv = len(config.invariants)
+    # Both step flavors share _step_stages, so the orbit-scan variants
+    # (prescan ladder, sig-prune coset scan) resolve from their env
+    # gates here at build time — set RAFT_TLA_SIGPRUNE before
+    # constructing the engine; keys are bit-identical either way.
     if routed:
         step = kernels.build_step_routed(
             config.bounds, config.spec, tuple(config.invariants),
@@ -980,10 +984,43 @@ class DDDEngine:
         with contextlib.ExitStack() as stack:
             # bound stack: tmpdir cleanup runs on EVERY exit, including
             # KeyboardInterrupt and unexpected errors (review r4)
+            self._install_sigint(stack)
             return self._check_impl(
                 init_override, on_progress, checkpoint,
                 checkpoint_every_s, resume, deadline_s, retain_store,
                 stack)
+
+    def _install_sigint(self, stack) -> None:
+        """The runs/campaign_stop.sh contract: the FIRST SIGINT sets a
+        flag the harvest loop reads next to the deadline check, so the
+        engine stops at the next segment boundary — pending candidates
+        flushed, a snapshot saved when a --checkpoint path is
+        configured, and a normal ``complete=False`` EngineResult
+        returned (the campaign wrapper then prints its endpoint JSON).
+        A SECOND SIGINT restores the previous handler and aborts raw
+        (KeyboardInterrupt), for when the graceful path is itself
+        wedged behind a dead dispatch.  signal.signal is main-thread-
+        only; off the main thread the flag stays False and Ctrl-C keeps
+        its raw meaning."""
+        import signal
+        import sys
+        import threading
+        self._sigint = False
+        if threading.current_thread() is not threading.main_thread():
+            return
+        prev = signal.getsignal(signal.SIGINT)
+
+        def handler(_signum, _frame):
+            if self._sigint:
+                signal.signal(signal.SIGINT, prev)
+                raise KeyboardInterrupt
+            self._sigint = True
+            print("SIGINT: stopping at the next segment boundary "
+                  "(SIGINT again aborts raw)", file=sys.stderr,
+                  flush=True)
+
+        signal.signal(signal.SIGINT, handler)
+        stack.callback(signal.signal, signal.SIGINT, prev)
 
     def _check_impl(self, init_override, on_progress, checkpoint,
                     checkpoint_every_s, resume, deadline_s,
@@ -1174,6 +1211,9 @@ class DDDEngine:
                             and time.monotonic() - t_warm > deadline_s):
                         complete = False
                         stopped = True
+                    if not stopped and self._sigint:
+                        complete = False      # graceful-stop contract:
+                        stopped = True        # flush+snapshot below
                     if not (block_done or stopped) and free:
                         idx = free.pop(0)
                         t_disp = time.monotonic()
@@ -1303,6 +1343,13 @@ class DDDEngine:
 
         n_states += self._flush(pend, master, host, constore, keystore,
                                 cov)
+        if self._sigint and checkpoint and not viol and not fail:
+            # graceful SIGINT stop: same mid-level snapshot shape as the
+            # periodic path above (pend flushed first, so re-running the
+            # partial block on resume dedups against the master keys)
+            self.save_checkpoint(checkpoint, host, constore, keystore,
+                                 n_states, n_trans, cov, level_ends,
+                                 blocks_done, (hi0, lo0))
         if fail:
             _cleanup.close()
             raise RuntimeError(

@@ -85,7 +85,7 @@ from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym_mod
 from raft_tla_tpu.parallel.shard_engine import (
-    _AXIS, _DCN, _mesh_axes, exchange, make_mesh)
+    _AXIS, _DCN, _mesh_axes, _shard_map, exchange, make_mesh)
 from raft_tla_tpu.utils import ckpt
 from raft_tla_tpu.utils import keyset
 from raft_tla_tpu.utils import native
@@ -243,6 +243,8 @@ def _build_segment(config: CheckConfig, caps: DDDShardCapacities, A: int,
         lane_map = jnp.asarray(cpx.cp_lane_map(config.bounds, config.spec,
                                                ndev))     # [ndev, A_loc]
     else:
+        # Orbit-scan variants (prescan, sig-prune) resolve from their
+        # env gates at build time — bit-identical keys either way.
         step = kernels.build_step(config.bounds, config.spec,
                                   tuple(config.invariants),
                                   config.symmetry, view=config.view)
@@ -452,7 +454,7 @@ class DDDShardEngine:
         fn = _build_segment(config, self.caps, self.A, self.lay.width,
                             self.schema, self.ndev, nici, axes)
         self._segment = jax.jit(
-            jax.shard_map(fn, mesh=self.mesh,
+            _shard_map(fn, mesh=self.mesh,
                           in_specs=(fc_specs, buf_specs, dp, dp, dp, dp,
                                     P(), P()),
                           out_specs=(fc_specs, buf_specs, st_specs),

@@ -83,6 +83,20 @@ from raft_tla_tpu.utils import pacing
 
 I32 = jnp.int32
 U32 = jnp.uint32
+
+
+def _shard_map(fn, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across the promotion boundary: the public name
+    (with ``check_vma``) only exists in newer jax; older releases have
+    the pre-promotion ``jax.experimental.shard_map`` (``check_rep``)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=check_vma)
+
+
 _AXIS = "d"     # the frontier/fingerprint mesh axis (DP, SURVEY §2.9)
 _DCN = "dcn"    # outer mesh axis for multi-slice scale-out (SURVEY §2.9
 #                 comm-backend row: ICI within a slice, DCN across slices)
@@ -230,6 +244,9 @@ def _build_segment(config: CheckConfig, caps: ShardCapacities,
     n_inv = len(config.invariants)
     if n_inv > 29:
         raise ValueError("at most 29 invariants (bit-packed into int32 flags)")
+    # Orbit-scan variants (prescan, sig-prune) resolve from their env
+    # gates at build time; keys stay bit-identical either way, so mixed
+    # settings across reshard/resume cannot corrupt the store.
     step = kernels.build_step(config.bounds, config.spec,
                               tuple(config.invariants), config.symmetry,
                               view=config.view)
@@ -458,7 +475,7 @@ class ShardEngine:
         specs = _carry_specs(axes)
         fn = _build_segment(config, self.caps, self.A, self.lay.width,
                             self.ndev, nici=nici, axes=axes)
-        self._segment = jax.jit(jax.shard_map(
+        self._segment = jax.jit(_shard_map(
             fn, mesh=self.mesh, in_specs=(specs, P()),
             out_specs=(P(), specs),
             check_vma=False), donate_argnums=(0,))
@@ -744,6 +761,8 @@ def reshard_checkpoint(config: CheckConfig, caps_src: ShardCapacities,
     consts_j = jnp.asarray(fpr.lane_constants(W))
     faithful = "allLogs" in lay.shapes
     if config.symmetry:
+        # host one-off: the unpruned scan is fine here (sig-prune keys
+        # are bit-identical, so either variant reproduces the store)
         orbit = sym_mod.build_orbit_fp(bounds, tuple(config.symmetry),
                                        consts_j, faithful)
 

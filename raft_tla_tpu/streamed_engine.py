@@ -107,6 +107,8 @@ def _build_segment(config: CheckConfig, caps: StreamedCapacities, A: int,
                    W: int, schema: bitpack.BitSchema):
     B = config.chunk
     n_inv = len(config.invariants)
+    # Orbit-scan variants (prescan, sig-prune) resolve from their env
+    # gates at build time — the segment must be rebuilt to change them.
     step = kernels.build_step(config.bounds, config.spec,
                               tuple(config.invariants), config.symmetry,
                               view=config.view)

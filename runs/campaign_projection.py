@@ -6,7 +6,10 @@ L31 partial), prints the current incremental rate, the pace ratio vs the
 r4 run at the same cumulative count, and a completion projection for a
 given stop deadline.
 
-Usage: python runs/campaign_projection.py [stop_utc_HH:MM]
+Usage: python runs/campaign_projection.py [stop_utc_HH:MM] [STATS_PATH]
+
+STATS_PATH (any argument without a ':') is the live stats stream to
+project from; default runs/elect5ddd.stats.
 """
 import datetime
 import json
@@ -18,10 +21,16 @@ RUNS = os.path.dirname(os.path.abspath(__file__))
 
 def load(name):
     """Parse a stats stream; rebase wall_s to a cumulative clock across
-    in-file resumes (each resume resets the runner's wall_s to ~0)."""
+    in-file resumes (each resume resets the runner's wall_s to ~0), then
+    drop flush lines whose n_states sits below the running maximum —
+    a checkpoint rollback (elect5ddd_r4_final.stats has one at L30:
+    693,861,831 -> 677,888,262) replays counts the surviving timeline
+    already passed, and interpolating against the pre-rollback lines
+    would bind the pace ratio to a discarded wall clock."""
     out = []
     offset = prev = 0.0
-    with open(os.path.join(RUNS, name)) as f:
+    with open(name if os.path.sep in name else os.path.join(RUNS, name)) \
+            as f:
         for line in f:
             line = line.strip()
             if not line:
@@ -32,11 +41,18 @@ def load(name):
             prev = d["wall_s"]
             d = dict(d, wall_s=d["wall_s"] + offset)
             out.append(d)
-    return out
+    n_max = -1
+    kept = []
+    for d in out:
+        if d["n_states"] >= n_max:
+            kept.append(d)
+            n_max = d["n_states"]
+    return kept
 
 
 def main():
-    live = load("elect5ddd.stats")
+    paths = [a for a in sys.argv[1:] if ":" not in a]
+    live = load(paths[0] if paths else "elect5ddd.stats")
     r4 = load("elect5ddd_r4_final.stats")
     if not live:
         sys.exit("no live stats yet")
@@ -75,14 +91,15 @@ def main():
     print(f"r4 endpoint {r4_end_states:,} (L30 complete; L31 partial "
           f"+83.4M; L30 size {lv_sizes.get(30, 0):,})")
 
-    if len(sys.argv) > 1:
-        hh, mm = map(int, sys.argv[1].split(":"))
+    stops = [a for a in sys.argv[1:] if ":" in a]
+    if stops:
+        hh, mm = map(int, stops[0].split(":"))
         now = datetime.datetime.now(datetime.timezone.utc)
         stop = now.replace(hour=hh, minute=mm, second=0, microsecond=0)
         if stop < now:
             stop += datetime.timedelta(days=1)
         left = (stop - now).total_seconds()
-        print(f"budget to {sys.argv[1]}Z: {left / 3600:.2f}h -> "
+        print(f"budget to {stops[0]}Z: {left / 3600:.2f}h -> "
               f"+{inc * left:,.0f} orbits at the current rate "
               f"(endpoint ~{n + inc * left:,.0f})")
 

@@ -1,16 +1,35 @@
 #!/bin/bash
-# Graceful elect5 campaign stop (round-5 endgame procedure).
-# SIGINT once -> the engine checkpoints at the next segment boundary and
-# exits with the endpoint JSON on stdout (runs/elect5ddd_r5b.out).
+# Graceful DDD campaign stop.
+# Contract (implemented in ddd_engine._install_sigint):
+#   SIGINT once -> the engine stops at the NEXT SEGMENT BOUNDARY: pending
+#   candidates are flushed, a snapshot is saved when the run has a
+#   --checkpoint path, and the engine returns a normal complete=False
+#   result — the campaign wrapper then prints its endpoint JSON
+#   (runs/elect5ddd_r5b.out is the r5 artifact of this shape).
+#   SIGINT twice -> raw abort (KeyboardInterrupt), for a wedged dispatch.
 # The r4/r5 operational traps this encodes:
 #   - never SIGKILL first (r4's kill during a wedged dispatch lost the worker
 #     for >1h);
 #   - after exit, the TPU worker claim needs ~10 min to release before any
 #     other process may touch the chip (8d92f00: 2.5 min relaunch wedged,
 #     10 min pause ran first try).
+# Usage: campaign_stop.sh [ENDPOINT_OUT] [STATS_FILE]
 set -u
-PID=$(pgrep -f "runs/elect5_ddd.py" | head -1)
-if [ -z "$PID" ]; then echo "no campaign process"; exit 1; fi
+OUT=${1:-/root/repo/runs/elect5ddd_r5b.out}
+STATS=${2:-/root/repo/runs/elect5ddd.stats}
+# match the python invocation itself, not wrappers/editors whose argv
+# happens to mention the script (an r5 near-miss: pgrep -f matched the
+# tail -f watching the log)
+MAPFILE=()
+while IFS= read -r line; do MAPFILE+=("$line"); done \
+    < <(pgrep -f "python[0-9.]* .*runs/elect5_ddd\.py")
+if [ "${#MAPFILE[@]}" -eq 0 ]; then echo "no campaign process"; exit 1; fi
+if [ "${#MAPFILE[@]}" -gt 1 ]; then
+    echo "ambiguous: ${#MAPFILE[@]} matching processes (${MAPFILE[*]}) —"
+    echo "refusing to signal; pick the PID and kill -INT it yourself"
+    exit 3
+fi
+PID=${MAPFILE[0]}
 echo "SIGINT -> $PID at $(date -u +%H:%M:%S)"
 kill -INT "$PID"
 for i in $(seq 1 180); do
@@ -19,9 +38,10 @@ for i in $(seq 1 180); do
 done
 if kill -0 "$PID" 2>/dev/null; then
     echo "still alive after 30 min; NOT escalating (wedge risk) — investigate"
+    echo "(a second 'kill -INT $PID' aborts raw WITHOUT the boundary flush)"
     exit 2
 fi
 echo "campaign exited at $(date -u +%H:%M:%S); endpoint tail:"
-tail -3 /root/repo/runs/elect5ddd_r5b.out
-tail -1 /root/repo/runs/elect5ddd.stats
+tail -3 "$OUT"
+tail -1 "$STATS"
 echo "worker-claim release pause: wait 10 min before the next chip job"

@@ -1,0 +1,172 @@
+"""Sync-timed A/B of signature-refinement orbit-scan pruning
+(ops/symmetry.build_orbit_fp ``prune=``) — decides the
+_sigprune_enabled auto policy.  The pruned scan probes exact server/
+value interchangeability per state (transposition probes gated by a
+cheap signature prefilter) and scans one permutation per coset of the
+verified stabilizer; its payoff therefore depends entirely on how
+symmetric the CHUNK is: a rung only engages when the chunk-max kept
+count fits it, i.e. when EVERY state in the chunk has a non-trivial
+verified stabilizer.
+
+Two measurements per shape, both with parity asserted bit-for-bit
+against the unpruned scan (the r3/r4 protocol: block_until_ready
+between reps, median of reps), at |G| = 6 (flagship), 24 (elect4) and
+120 (elect5):
+
+- ``mid``: distinct mid-depth rows, the prescan_ab pool — the regime
+  the flagship/elect5 campaigns actually spend their wall in, where
+  states are dominated by fully-asymmetric role/term/log assignments;
+- ``shallow``: the first BFS levels tiled to the chunk — the
+  symmetric-rich regime (few elections have happened; most servers are
+  exactly interchangeable) where the rungs can engage.
+
+Plus an in-engine DDD A/B (RAFT_TLA_SIGPRUNE=off vs on, engines built
+fresh per arm — the gate is read at step-build time) asserting
+n_states/diameter/transitions parity and comparing end-to-end wall.
+
+Usage: python runs/sigprune_ab.py [--cpu] [reps] [chunk]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+if "--cpu" in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.models import interp
+from raft_tla_tpu.ops import kernels
+
+_ints = [int(a) for a in sys.argv[1:] if a.isdigit()]
+REPS = _ints[0] if _ints else 7
+B = _ints[1] if len(_ints) > 1 else 1024
+
+SHAPES = {
+    "flagship": (Bounds(n_servers=3, n_values=2, max_term=2, max_log=1,
+                        max_msgs=2, max_dup=1),
+                 "full", ("NoTwoLeaders", "LogMatching",
+                          "CommittedWithinLog", "LeaderCompleteness")),
+    "elect4": (Bounds(n_servers=4, n_values=2, max_term=2, max_log=0,
+                      max_msgs=2, max_dup=1),
+               "election", ("NoTwoLeaders", "CommittedWithinLog")),
+    "elect5": (Bounds(n_servers=5, n_values=2, max_term=2, max_log=0,
+                      max_msgs=2, max_dup=1),
+               "election", ("NoTwoLeaders", "CommittedWithinLog")),
+}
+
+
+def _pools(bounds, spec):
+    """(mid, shallow) row pools, each exactly B rows."""
+    init = interp.init_state(bounds)
+    frontier, seen, mid = [init], {init}, []
+    shallow, depth = [init], 0
+    while len(mid) < B:
+        if not frontier:
+            raise SystemExit(f"space exhausted below {B} distinct rows")
+        nxt = []
+        for s in frontier:
+            if not interp.constraint_ok(s, bounds):
+                continue
+            for _i, t in interp.successors(s, bounds, spec=spec):
+                if t not in seen:
+                    seen.add(t)
+                    nxt.append(t)
+        frontier = nxt
+        depth += 1
+        if depth <= 2:
+            shallow += [s for s in frontier
+                        if interp.constraint_ok(s, bounds)]
+        mid = [s for s in frontier if interp.constraint_ok(s, bounds)]
+    mid_rows = np.stack([interp.to_vec(s, bounds) for s in mid[:B]])
+    srows = np.stack([interp.to_vec(s, bounds) for s in shallow])
+    shallow_rows = np.tile(srows, (-(-B // len(srows)), 1))[:B]
+    return mid_rows, shallow_rows
+
+
+def _time_step(bounds, spec, invs, vecs):
+    """(ms_off, ms_pruned), parity-asserted."""
+    out, ref_fp = {}, None
+    for name, gate in (("off", lambda *_: False),
+                       ("pruned", lambda *_: True)):
+        saved = kernels._sigprune_enabled
+        kernels._sigprune_enabled = gate    # measure the comparison the
+        try:                                # gate encodes — bypass it
+            fn = jax.jit(kernels.build_step(bounds, spec, invs,
+                                            ("Server",)))
+            r = fn(vecs)
+            jax.block_until_ready(r)
+        finally:
+            kernels._sigprune_enabled = saved
+        fp = (np.asarray(r["fp_hi"]), np.asarray(r["fp_lo"]))
+        if ref_fp is None:
+            ref_fp = fp
+        else:
+            assert np.array_equal(fp[0], ref_fp[0])
+            assert np.array_equal(fp[1], ref_fp[1])
+        times = []
+        for _ in range(REPS):
+            t0 = time.monotonic()
+            jax.block_until_ready(fn(vecs))
+            times.append(time.monotonic() - t0)
+        out[name] = sorted(times)[len(times) // 2]
+    return out["off"], out["pruned"]
+
+
+results = {"platform": jax.devices()[0].platform, "chunk": B,
+           "reps": REPS, "step": {}, "inengine": {}}
+for shape, (bounds, spec, invs) in SHAPES.items():
+    mid, shallow = _pools(bounds, spec)
+    results["step"][shape] = {}
+    for pool, rows in (("mid", mid), ("shallow", shallow)):
+        ms_off, ms_pr = _time_step(bounds, spec, invs, jnp.asarray(rows))
+        results["step"][shape][pool] = {
+            "ms_off": round(ms_off * 1e3, 2),
+            "ms_pruned": round(ms_pr * 1e3, 2),
+            "speedup_from_prune": round(ms_off / ms_pr, 3)}
+        print(f"{shape:9} {pool:8} off {ms_off * 1e3:8.2f} ms/chunk  "
+              f"pruned {ms_pr * 1e3:8.2f} ms/chunk  "
+              f"({ms_off / ms_pr:5.2f}x)", flush=True)
+
+# in-engine: fresh DDD engines per arm (the gate is read at build time).
+# |G|=24 election space, ONE value (values are inert at max_log=0, so
+# this halves the wall without changing the symmetry structure) and ONE
+# message slot per type (the m2 variant's single arm blew a 60-min solo
+# window on the 1-core host) — small enough to run EXHAUSTIVELY twice
+# on a single CPU core, deep enough that mid-depth chunks dominate the
+# wall like a real campaign.
+from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+
+cfg = CheckConfig(bounds=Bounds(n_servers=4, n_values=1, max_term=2,
+                                max_log=0, max_msgs=1, max_dup=1),
+                  spec="election",
+                  invariants=("NoTwoLeaders",), symmetry=("Server",),
+                  chunk=B)
+caps = DDDCapacities(block=1 << 14, table=1 << 16, flush=1 << 16,
+                     levels=64)
+parity = {}
+for mode in ("off", "on"):
+    os.environ["RAFT_TLA_SIGPRUNE"] = mode
+    t0 = time.monotonic()
+    r = DDDEngine(cfg, caps).check()
+    wall = time.monotonic() - t0
+    parity[mode] = (r.n_states, r.diameter, r.n_transitions)
+    results["inengine"][mode] = {
+        "wall_s": round(wall, 2), "n_states": r.n_states,
+        "diameter": r.diameter, "n_transitions": r.n_transitions}
+    print(f"inengine  {mode:3}  {wall:7.2f} s  {r.n_states} states "
+          f"diameter {r.diameter}", flush=True)
+os.environ.pop("RAFT_TLA_SIGPRUNE", None)
+assert parity["on"] == parity["off"], parity
+results["inengine"]["speedup_from_prune"] = round(
+    results["inengine"]["off"]["wall_s"]
+    / max(results["inengine"]["on"]["wall_s"], 1e-9), 3)
+
+print(json.dumps(results))

@@ -100,6 +100,7 @@ def test_stream_rows_width_mismatch_rejected(tmp_path):
         ckpt.stream_rows_in(p, got.append, 5, expect_width=4)
 
 
+@pytest.mark.slow      # virtual-mesh test (see test_shard_engine)
 def test_shard_checkpoint_resume_bit_exact(tmp_path):
     """Same carry-purity argument on the 8-device mesh: a snapshot taken
     mid-search resumes to the identical result (and a different mesh size

@@ -22,6 +22,10 @@ from raft_tla_tpu.ddd_engine import _EMPTY, DDDCapacities, DDDEngine, \
     _filter_insert
 from raft_tla_tpu.models import refbfs
 
+import pytest
+# smoke tier: cross-section for mid-round changes (pytest -m smoke)
+pytestmark = pytest.mark.smoke
+
 U32 = jnp.uint32
 
 

@@ -10,6 +10,7 @@ covers awkward shapes (S not divisible by ndev, ndev > S).
 """
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -19,7 +20,7 @@ from raft_tla_tpu.models import interp, spec as SP
 from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.parallel.cp_expand import (
     build_cp_step, cp_lane_count, cp_lane_map)
-from raft_tla_tpu.parallel.shard_engine import make_mesh, _AXIS
+from raft_tla_tpu.parallel.shard_engine import make_mesh, _AXIS, _shard_map
 
 from test_state import random_pystate
 
@@ -46,11 +47,12 @@ def _run_cp(bounds, spec, invs, sym, vecs, ndev):
     def shard_fn(v):
         return step(v, jax.lax.axis_index(_AXIS))
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(_shard_map(
         shard_fn, mesh=mesh, in_specs=P(), out_specs=P(_AXIS)))(vecs)
     return {k: np.asarray(v) for k, v in out.items()}
 
 
+@pytest.mark.slow      # virtual-mesh test (see test_shard_engine)
 def test_cp_step_matches_dense_per_lane():
     rng = np.random.default_rng(23)
     states = [random_pystate(rng, B5) for _ in range(8)]
@@ -88,6 +90,7 @@ def test_cp_step_matches_dense_per_lane():
                                               dense["con_ok"][:, g])
 
 
+@pytest.mark.slow      # virtual-mesh test (see test_shard_engine)
 def test_cp_step_faithful_mode():
     """History fields (allLogs union) ride the CP expansion too."""
     bounds = Bounds(n_servers=2, n_values=1, max_term=2, max_log=1,

@@ -16,6 +16,9 @@ from raft_tla_tpu.config import Bounds, CheckConfig
 from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
 from raft_tla_tpu.models import interp, refbfs
 
+# smoke tier: cross-section for mid-round changes (pytest -m smoke)
+pytestmark = pytest.mark.smoke
+
 CFG = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
                                 max_log=0, max_msgs=2),
                   spec="election", invariants=("NoTwoLeaders",), chunk=32)

@@ -75,6 +75,7 @@ def test_paged_engine_deadlock_parity():
     _assert_deadlock(got, ref)
 
 
+@pytest.mark.slow      # virtual-mesh test (see test_shard_engine)
 def test_shard_engine_deadlock():
     """Like violation traces, deadlock reporting in the sharded engine is
     interleaving-dependent in its level accounting (module docstring); the

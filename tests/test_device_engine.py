@@ -13,6 +13,9 @@ from raft_tla_tpu.device_engine import Capacities, DeviceEngine
 from raft_tla_tpu.models import interp, refbfs, spec as S
 from raft_tla_tpu.ops import msgbits as mb
 
+# smoke tier: cross-section for mid-round changes (pytest -m smoke)
+pytestmark = pytest.mark.smoke
+
 CAPS = Capacities(n_states=1 << 15, levels=64)
 
 

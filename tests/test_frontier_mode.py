@@ -181,6 +181,7 @@ def _mesh_caps(**kw):
     return DDDShardCapacities(**base)
 
 
+@pytest.mark.slow      # virtual-mesh test (see test_shard_engine)
 def test_mesh_frontier_parity_8dev():
     from raft_tla_tpu.parallel.ddd_shard_engine import DDDShardEngine
     from raft_tla_tpu.parallel.shard_engine import make_mesh
@@ -193,6 +194,7 @@ def test_mesh_frontier_parity_8dev():
     assert got.levels == ref.levels
 
 
+@pytest.mark.slow      # virtual-mesh test (see test_shard_engine)
 def test_mesh_frontier_checkpoint_resume_and_reshard(tmp_path):
     """Mesh frontier: snapshot, resume in place, and reshard the
     frontier snapshot 8 -> 2 (keys + level files move verbatim)."""
@@ -279,6 +281,7 @@ def test_frontier_keep_levels_deadlock_trace():
     _assert_replayable(got.violation.trace, cfg)
 
 
+@pytest.mark.slow      # virtual-mesh test (see test_shard_engine)
 def test_frontier_keep_levels_shard_trace():
     from raft_tla_tpu.parallel.ddd_shard_engine import (
         DDDShardCapacities, DDDShardEngine)

@@ -19,6 +19,10 @@ import independent_oracle as oracle
 from raft_tla_tpu.config import Bounds, CheckConfig
 from raft_tla_tpu.models import refbfs
 
+import pytest
+# smoke tier: cross-section for mid-round changes (pytest -m smoke)
+pytestmark = pytest.mark.smoke
+
 # Hand-derived in runs/worksheet_levels.md, action family by action family
 # from raft.tla:155-465 with explicit set-counting: levels 0-4 of the
 # reference raft.cfg universe under the t2/l1/m2 constraint.  Levels 5-7

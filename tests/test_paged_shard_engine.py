@@ -9,6 +9,11 @@ every level).
 import numpy as np
 import pytest
 
+# needs the virtual multi-device mesh — the slowest compiles on
+# this 1-core host, excluded from the time-boxed tier-1 window
+# (-m 'not slow'); the shard family stays exercised via -m smoke.
+pytestmark = pytest.mark.slow
+
 from raft_tla_tpu.config import Bounds, CheckConfig
 from raft_tla_tpu.models import interp, refbfs
 from raft_tla_tpu.parallel.paged_shard_engine import (

@@ -14,6 +14,9 @@ from raft_tla_tpu.models import interp, refbfs, spec as S
 from raft_tla_tpu.ops import msgbits as mb
 from raft_tla_tpu.parallel import ShardCapacities, ShardEngine, make_mesh
 
+# smoke tier: cross-section for mid-round changes (pytest -m smoke)
+pytestmark = [pytest.mark.smoke, pytest.mark.slow]
+
 CAPS = ShardCapacities(n_states=1 << 12, levels=64)
 
 

@@ -214,6 +214,7 @@ def test_tlc_export_carries_view():
         fb, ("NoTwoLeaders",), False, False, "deadvotes")
 
 
+@pytest.mark.slow      # virtual-mesh test (see test_shard_engine)
 def test_mesh_engine_under_view():
     from raft_tla_tpu.parallel.ddd_shard_engine import (
         DDDShardCapacities, DDDShardEngine)
