@@ -1,0 +1,31 @@
+"""Static analysis layer — the checker that guards the checker.
+
+The runtime engines trust one unproved assumption (SURVEY §4.5): every
+transition kernel writes values that fit the per-field bit widths
+``ops/bitpack.field_bits`` derives from :class:`~raft_tla_tpu.config.Bounds`.
+One overflowing write — a term increment past ``term_cap``, a bitmask past
+``n`` bits — is silently truncated by the pack, collides fingerprints, and
+turns "exhaustive check passed" into a false negative with no runtime
+symptom.  This package closes that hole at build time, before any state is
+expanded:
+
+- **Pass 1** (:mod:`.widthcheck`): an interval abstract interpreter over the
+  state schema *proves* width-safety per transition — classic abstract
+  interpretation (Cousot & Cousot 1977) on the guard/update structure of
+  ``ops/kernels``;
+- **Pass 2** (:mod:`.cfglint`): diagnostics for the cfg/invariant/view
+  surface (unknown names with did-you-mean, vacuous invariants,
+  symmetry/view compatibility) — TLC's "check the model before trusting
+  the run" philosophy (Yu, Manolios & Lamport);
+- **Pass 3** (:mod:`.jitlint`): a stdlib-``ast`` lint over the kernel and
+  engine sources for known JAX tracer hazards (Python ``if`` on traced
+  values, nondeterministic set iteration, ``int()`` casts of tracers,
+  unannotated dtype narrowing).
+
+Entry points: ``python -m raft_tla_tpu.lint`` (standalone CLI),
+``check.py --lint`` (Pass 1 at step-build time, warn-only by default),
+and the individual ``check_*`` functions for tests and the seeded-mutation
+harness (``tests/test_lint_mutations.py``).
+"""
+
+from raft_tla_tpu.analysis.report import Finding, render, has_errors  # noqa: F401

@@ -1,0 +1,126 @@
+"""speclint CLI — run the three analysis passes over a model config.
+
+::
+
+    python -m raft_tla_tpu.lint runs/MC3s2v.cfg            # both modes
+    python -m raft_tla_tpu.lint runs/MC3s2v.cfg --strict   # warnings fail
+    python -m raft_tla_tpu.lint --mode faithful --spec election cfg
+    python -m raft_tla_tpu.lint                            # no cfg: passes 1+3
+
+(``python -m raft_tla_tpu.analysis`` is the same program.)
+
+Exit code: 0 when every pass proves its property (warnings allowed),
+1 on any error finding — or on any finding at all under ``--strict``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from raft_tla_tpu.analysis import report
+from raft_tla_tpu.analysis.report import Finding
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="raft-tla-lint",
+        description="static width-safety and spec-consistency analyzer: "
+                    "proves the packed encodings cannot silently truncate "
+                    "(Pass 1), lints the cfg against the model registries "
+                    "(Pass 2), and flags tracer-hostile idioms in the "
+                    "kernel/engine sources (Pass 3)")
+    p.add_argument("cfg", nargs="?", default=None,
+                   help="TLC model config (.cfg); omit to run only the "
+                        "width and jit passes on default bounds")
+    p.add_argument("--mode", choices=("parity", "faithful", "both"),
+                   default="both",
+                   help="which encoding mode(s) to prove (default: both)")
+    p.add_argument("--spec", default="full",
+                   help="action-family subset, as in check.py (default: "
+                        "full)")
+    p.add_argument("--view", default=None,
+                   help="CLI state view name (models/views registry) to "
+                        "check symmetry/invariant compatibility against")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on warnings too")
+    p.add_argument("--max-term", type=int, default=None, metavar="N")
+    p.add_argument("--max-log", type=int, default=None, metavar="N")
+    p.add_argument("--max-msgs", type=int, default=None, metavar="N")
+    p.add_argument("--max-dup", type=int, default=None, metavar="N")
+    p.add_argument("--skip", action="append", default=[],
+                   choices=("width", "cfg", "jit"),
+                   help="skip a pass (repeatable)")
+    return p
+
+
+def _bounds_for(args, cfg, history: bool):
+    from raft_tla_tpu.config import Bounds
+    kw = {"history": history}
+    if cfg is not None:
+        kw["n_servers"] = len(cfg.server_names())
+        kw["n_values"] = len(cfg.value_names())
+    for flag in ("max_term", "max_log", "max_msgs", "max_dup"):
+        v = getattr(args, flag)
+        if v is not None:
+            kw[flag] = v
+    return Bounds(**kw)
+
+
+def run_lint(args) -> tuple[list, int]:
+    """All requested passes; returns (findings, exit_code)."""
+    from raft_tla_tpu.analysis import cfglint, jitlint, widthcheck
+    from raft_tla_tpu.utils.cfgparse import load_cfg
+
+    cfg = None
+    if args.cfg is not None:
+        try:
+            cfg = load_cfg(args.cfg)
+        except (OSError, ValueError) as e:
+            f = Finding(report.CFG, report.ERROR, "cfg-unreadable", str(e),
+                        file=args.cfg)
+            return [f], 1
+
+    modes = {"parity": (False,), "faithful": (True,),
+             "both": (False, True)}[args.mode]
+    findings: list = []
+    for history in modes:
+        tag = "faithful" if history else "parity"
+        try:
+            bounds = _bounds_for(args, cfg, history)
+        except ValueError as e:
+            findings.append(Finding(
+                report.WIDTH, report.ERROR, "bounds-invalid",
+                f"[{tag}] {e}", file=args.cfg))
+            continue
+        if "width" not in args.skip:
+            for f in widthcheck.check_widths(bounds, args.spec):
+                findings.append(_tagged(f, tag))
+        if cfg is not None and "cfg" not in args.skip:
+            for f in cfglint.lint_cfg(cfg, bounds, spec=args.spec,
+                                      view=args.view, path=args.cfg):
+                findings.append(_tagged(f, tag))
+    if "jit" not in args.skip:
+        findings += jitlint.lint_paths()
+    return findings, report.exit_code(findings, strict=args.strict)
+
+
+def _tagged(f: Finding, tag: str) -> Finding:
+    return Finding(f.pass_, f.severity, f.code, f"[{tag}] {f.message}",
+                   transition=f.transition, field=f.field,
+                   interval=f.interval, width=f.width, file=f.file,
+                   line=f.line)
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    findings, code = run_lint(args)
+    target = args.cfg or "(no cfg)"
+    print(report.render(
+        findings, header=f"speclint: {target} mode={args.mode} "
+                         f"spec={args.spec}"))
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,263 @@
+"""Pass 3 — jit-hazard AST lint over the kernel and engine sources.
+
+Stdlib-``ast`` only (no libcst in the image).  The hazards are the ones
+that have actually bitten JAX model-checker kernels:
+
+- ``traced-python-if`` — a Python ``if`` whose test compares elements of
+  a traced operand inside a function that manipulates ``jnp``/``lax``
+  values: under ``jit`` this either raises ``TracerBoolConversionError``
+  or, worse, burns the first call's value into the compiled code.
+- ``traced-scalar-cast`` — ``int(...)``/``float(...)`` of a traced
+  expression: concretizes the tracer (same failure mode).
+- ``set-iteration`` — iterating a set literal / ``set(...)`` call:
+  Python set order is salted per process, so any traced computation
+  assembled from it compiles a different program per run — a
+  nondeterminism source a fingerprint-deduplicating checker cannot
+  afford.
+- ``narrow-astype`` — ``.astype`` to a sub-32-bit dtype with no width
+  justification in a comment on the same line: silent truncation is the
+  exact bug class Pass 1 proves away for the packed encodings; ad-hoc
+  narrowing must carry its own proof.
+
+Heuristics, not semantics — so every rule is waivable with a
+``# lint: jit-ok`` comment on the offending line, and all Pass 3
+findings are warnings (exit 0 unless ``--strict``).  Traced-ness is
+approximated as "rooted in a parameter of a function whose body
+mentions jnp/lax"; tests of ``.shape``/``.ndim``/``len()`` and
+``in``/``is`` comparisons are static under jit and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from raft_tla_tpu.analysis.report import JIT, WARNING, Finding
+
+WAIVER = "lint: jit-ok"
+
+# Default scan set: the kernel layer and every engine (the jit surface).
+DEFAULT_TARGETS = (
+    "raft_tla_tpu/ops",
+    "raft_tla_tpu/engine.py",
+    "raft_tla_tpu/device_engine.py",
+    "raft_tla_tpu/paged_engine.py",
+    "raft_tla_tpu/streamed_engine.py",
+    "raft_tla_tpu/ddd_engine.py",
+    "raft_tla_tpu/parallel",
+)
+
+_NARROW_DTYPES = {"int8", "int16", "uint8", "uint16", "bfloat16", "float16",
+                  "bool_"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _mentions_traced(node: ast.AST) -> bool:
+    """Does this function's body textually use jnp/lax/jax values?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in ("jnp", "lax", "jax"):
+            return True
+    return False
+
+
+def _param_names(fn: ast.AST) -> set:
+    a = fn.args
+    names = {p.arg for p in a.args + a.posonlyargs + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    # self/cls are never tracers in this codebase; bounds/xp are static.
+    return names - {"self", "cls", "bounds", "xp", "cfg", "config"}
+
+
+def _root_name(node: ast.AST) -> str | None:
+    """The Name at the root of an attribute/subscript/call chain."""
+    while True:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        else:
+            return None
+
+
+def _is_static_test(node: ast.AST) -> bool:
+    """Tests that never touch tracer *values*: shape/ndim/dtype probes,
+    len() of containers, identity and membership tests, isinstance."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id in ("len", "isinstance", "hasattr",
+                                    "callable"):
+            return True
+        if isinstance(sub, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn, ast.Is, ast.IsNot))
+                for op in sub.ops):
+            return True
+    return False
+
+
+def _param_subscript_roots(node: ast.AST, params: set) -> set:
+    """Parameter names whose *elements* the expression reads (x[i], a
+    tracer if x is traced input; a bare `x` name could be a loop bound)."""
+    roots = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Subscript):
+            r = _root_name(sub.value)
+            if r in params:
+                roots.add(r)
+    return roots
+
+
+class _FnVisitor(ast.NodeVisitor):
+    def __init__(self, path: str, src_lines: list):
+        self.path = path
+        self.src_lines = src_lines
+        self.findings: list = []
+        self._fn_stack: list = []
+
+    # -- helpers -------------------------------------------------------------
+    def _waived(self, lineno: int) -> bool:
+        line = self.src_lines[lineno - 1] if lineno <= len(self.src_lines) \
+            else ""
+        return WAIVER in line
+
+    def _line_comment(self, lineno: int) -> str:
+        line = self.src_lines[lineno - 1] if lineno <= len(self.src_lines) \
+            else ""
+        idx = line.find("#")
+        return line[idx:] if idx >= 0 else ""
+
+    def _emit(self, code: str, message: str, node: ast.AST):
+        if self._waived(node.lineno):
+            return
+        self.findings.append(Finding(
+            JIT, WARNING, code, message, file=self.path, line=node.lineno))
+
+    def _in_traced_fn(self) -> bool:
+        return bool(self._fn_stack) and self._fn_stack[-1]["traced"]
+
+    def _params(self) -> set:
+        return self._fn_stack[-1]["params"] if self._fn_stack else set()
+
+    # -- visitors ------------------------------------------------------------
+    def _visit_fn(self, node):
+        self._fn_stack.append({
+            "traced": _mentions_traced(node),
+            "params": _param_names(node),
+        })
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_If(self, node: ast.If):
+        if self._in_traced_fn() and not _is_static_test(node.test):
+            roots = _param_subscript_roots(node.test, self._params())
+            if roots:
+                self._emit(
+                    "traced-python-if",
+                    "Python `if` on a value read from traced operand "
+                    f"{'/'.join(sorted(roots))}: under jit this raises "
+                    "TracerBoolConversionError or bakes in the traced "
+                    "value — use jnp.where/lax.cond", node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        if self._in_traced_fn() and isinstance(node.func, ast.Name) \
+                and node.func.id in ("int", "float", "bool") and node.args:
+            roots = _param_subscript_roots(node.args[0], self._params())
+            if roots:
+                self._emit(
+                    "traced-scalar-cast",
+                    f"{node.func.id}() of a value read from traced operand "
+                    f"{'/'.join(sorted(roots))}: concretizes the tracer "
+                    "under jit — keep it an array", node)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For):
+        it = node.iter
+        is_set = isinstance(it, ast.Set) or (
+            isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+            and it.func.id in ("set", "frozenset"))
+        if is_set:
+            self._emit(
+                "set-iteration",
+                "iteration over a set: order is salted per process, so "
+                "any program assembled from it differs run to run — "
+                "iterate a sorted() or a tuple", node)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        self.generic_visit(node)
+
+    def visit_Constant(self, node):
+        pass
+
+    def _check_astype(self, node: ast.Call):
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype" and node.args):
+            return
+        arg = node.args[0]
+        dtype = None
+        if isinstance(arg, ast.Attribute):
+            dtype = arg.attr
+        elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            dtype = arg.value
+        if dtype in _NARROW_DTYPES:
+            comment = self._line_comment(node.lineno)
+            if "bit" not in comment and "width" not in comment \
+                    and WAIVER not in comment:
+                self._emit(
+                    "narrow-astype",
+                    f"narrowing .astype({dtype}) without a width comment: "
+                    "state a `# <n>-bit ...` justification (or waive) so "
+                    "the truncation is provably safe", node)
+
+    def generic_visit(self, node):
+        if isinstance(node, ast.Call):
+            self._check_astype(node)
+        super().generic_visit(node)
+
+
+def lint_source(src: str, path: str = "<string>") -> list:
+    """Lint one source text; returns findings (all warnings)."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(JIT, WARNING, "syntax-error",
+                        f"could not parse: {e.msg}", file=path,
+                        line=e.lineno)]
+    v = _FnVisitor(path, src.splitlines())
+    v.visit(tree)
+    return v.findings
+
+
+def lint_paths(targets=DEFAULT_TARGETS, root: str | None = None) -> list:
+    """Lint every .py under the target files/dirs (relative to repo
+    root, resolved against this package's parent by default)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    findings = []
+    for target in targets:
+        full = os.path.join(root, target)
+        if os.path.isfile(full):
+            files = [full]
+        elif os.path.isdir(full):
+            files = sorted(
+                os.path.join(full, f) for f in os.listdir(full)
+                if f.endswith(".py"))
+        else:
+            continue
+        for path in files:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            rel = os.path.relpath(path, root)
+            findings += lint_source(src, rel)
+    return findings

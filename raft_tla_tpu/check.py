@@ -204,6 +204,15 @@ def build_argparser() -> argparse.ArgumentParser:
                         "every engine inherits one decision; default: "
                         "leave the env/auto policy alone (auto is "
                         "currently OFF — RESULTS.md 'sig-prune A/B')")
+    p.add_argument("--lint", default="warn", choices=("warn", "strict"),
+                   help="static width-safety pass (analysis/widthcheck) "
+                        "before any step build: prove no transition can "
+                        "overflow a packed field for these bounds. 'warn' "
+                        "(default) prints findings and proceeds; 'strict' "
+                        "makes any finding fatal. The full three-pass "
+                        "analyzer is `python -m raft_tla_tpu.lint`")
+    p.add_argument("--no-lint", action="store_true",
+                   help="skip the static width-safety pass")
     p.add_argument("--stats", action="store_true",
                    help="emit one JSON line of run stats per search segment "
                         "on stderr (device/paged/shard engines)")
@@ -225,6 +234,7 @@ def build_argparser() -> argparse.ArgumentParser:
 def _resolve_config(args):
     from raft_tla_tpu.config import Bounds, CheckConfig
     from raft_tla_tpu.models import invariants as inv_mod
+    from raft_tla_tpu.utils import cfgparse
     from raft_tla_tpu.utils.cfgparse import load_cfg
 
     cfg = load_cfg(args.cfg)
@@ -239,11 +249,10 @@ def _resolve_config(args):
             f"unsupported INIT/NEXT ({cfg.init!r}/{cfg.next!r}): only the "
             "spec's Init (raft.tla:155-160) and Next (raft.tla:454-465) "
             "are compiled")
-    unknown = [nm for nm in cfg.invariants if nm not in inv_mod.REGISTRY]
-    if unknown:
-        raise ValueError(
-            f"unknown invariant(s) {unknown}; registry: "
-            f"{sorted(inv_mod.REGISTRY)}")
+    # Unknown names fail at resolve time with the offending cfg line and
+    # a did-you-mean (one resolver, shared with the Pass 2 lint).
+    cfgparse.resolve_names(cfg.invariants, inv_mod.REGISTRY, "invariant",
+                           cfg=cfg, path=args.cfg)
     from raft_tla_tpu.models import liveness as live_mod
     for nm in cfg.properties:
         live_mod.parse_property(nm)     # raises with both registries
@@ -527,6 +536,26 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"Error: {e}", file=sys.stderr)
         return EXIT_ERROR
+
+    if not args.no_lint:
+        # Width-safety (analysis Pass 1) before any step build: for these
+        # exact bounds, no transition can write a value the bit-pack would
+        # truncate.  Warn-only by default — the proof failing means the
+        # analyzer and kernels disagree, which deserves eyes, not a wall —
+        # but --lint strict turns any finding into a hard stop.
+        from raft_tla_tpu.analysis import report as _report
+        from raft_tla_tpu.analysis import widthcheck as _widthcheck
+        try:
+            _lint = _widthcheck.check_widths(config.bounds, args.spec)
+        except Exception as e:      # analyzer bug: report, don't block
+            _lint = [_report.Finding(
+                _report.WIDTH, _report.ERROR, "lint-internal-error",
+                f"width pass crashed: {e!r}")]
+        if _lint:
+            print(_report.render(
+                _lint, header="speclint (width pass):"), file=sys.stderr)
+            if args.lint == "strict":
+                return EXIT_ERROR
 
     b = config.bounds
     print(f"raft_tla_tpu {__import__('raft_tla_tpu').__version__} — "

@@ -685,7 +685,7 @@ class DeviceEngine:
                 st.unpack(rows[k], self.lay, np), self.bounds)
             label = self.table[int(lane[g])].label() if g > 0 else None
             chain.append((label, py))
-        vi = int(out["viol_i"])
+        vi = int(out["viol_i"])   # lint: jit-ok — host path, out is fetched
         inv_name = DEADLOCK if vi == len(self.config.invariants) \
             else self.config.invariants[vi]
         return Violation(invariant=inv_name, state=chain[-1][1], trace=chain)

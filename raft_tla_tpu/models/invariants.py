@@ -269,6 +269,26 @@ HISTORY_REGISTRY = {
 }
 REGISTRY.update(HISTORY_REGISTRY)
 
+# Which struct fields each invariant's predicate reads — the spec-lint
+# (analysis/cfglint) side of the metadata: an invariant whose READS are
+# never written by any transition in the active spec subset is vacuous
+# (statically constant given Init), and an invariant reading fields a
+# VIEW rewrites is checked only up to the view.  Keep in sync with the
+# _py_*/_jnp_* bodies above.
+READS = {
+    "NoTwoLeaders": ("role", "term"),
+    "ElectionSafety": ("role", "term"),
+    "NaiveNoTwoLeaders": ("role",),
+    "LogMatching": ("logTerm", "logVal", "logLen"),
+    "CommittedWithinLog": ("commitIndex", "logLen"),
+    "LeaderCompleteness": ("role", "term", "logTerm", "logVal", "logLen",
+                           "commitIndex"),
+    "ElectionSafetyHist": ("eTerm", "eLeader"),
+    "LeaderCompletenessHist": ("eTerm", "eLog", "term", "commitIndex",
+                               "logTerm", "logVal", "logLen"),
+    "AllLogsPrefixClosed": ("allLogs",),
+}
+
 
 def py_invariant(name: str):
     return REGISTRY[name][0]

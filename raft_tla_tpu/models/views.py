@@ -50,6 +50,19 @@ REGISTRY = {
                  "(exact: dead-variable elimination)",
 }
 
+# Spec-lint metadata (analysis/cfglint).  VIEW_WRITES: the fields a view
+# rewrites before fingerprinting — invariants reading them are checked
+# only up to the view, worth a diagnostic.  EQUIVARIANT_AXES: the
+# permutation axes the view commutes with — SYMMETRY on any other axis
+# would make view-fingerprints orbit-dependent (unsound dedup).  Keep in
+# sync with the py_view/jnp_view bodies below.
+VIEW_WRITES = {
+    "deadvotes": ("vResp", "vGrant"),
+}
+EQUIVARIANT_AXES = {
+    "deadvotes": ("Server", "Value"),
+}
+
 
 def py_view(name: str):
     """Host-side view map: PyState -> PyState (the oracle twin)."""

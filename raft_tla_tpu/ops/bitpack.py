@@ -35,6 +35,31 @@ def _bits(max_value: int) -> int:
     return max(1, int(max_value).bit_length())
 
 
+# Fields whose packed width is a RAW bit block, not a value range: the
+# allLogs mask words carry 32 bits of set-membership data (the int32 sign
+# bit is data, uint32 semantics).  The analyzer exempts these from the
+# "width <= 31 so int32 stays non-negative" flat-vector rule.
+RAW_FIELDS = ("allLogs",)
+
+
+def width_table(bounds: Bounds) -> dict:
+    """The full width contract for one Bounds instance — the table the
+    static analyzer (analysis/widthcheck) proves the kernels against.
+
+    Returns ``{"bits": field -> width, "raw": RAW_FIELDS subset present,
+    "total_bits": packed row bits, "packed_words": P, "flat_words": W}``.
+    """
+    schema = BitSchema(bounds)
+    fb = field_bits(bounds)
+    return {
+        "bits": fb,
+        "raw": tuple(f for f in RAW_FIELDS if f in fb),
+        "total_bits": schema.total_bits,
+        "packed_words": schema.P,
+        "flat_words": schema.W,
+    }
+
+
 def field_bits(bounds: Bounds) -> dict:
     """Per-element bit width for every Layout field (pack() order)."""
     n = bounds.n_servers

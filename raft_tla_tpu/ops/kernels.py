@@ -453,6 +453,47 @@ _FAMILY_KERNELS = {
     SP.DROP: (k_drop, ("slot",)),
 }
 
+# Which struct fields each family's kernel can write (beyond copying the
+# input).  This is the kernel side of the width-safety contract: the
+# static analyzer (analysis/widthcheck) keeps an abstract transfer twin
+# per family and cross-checks the two write-sets, so a kernel growing a
+# new write without the twin being re-proved fails the lint loudly.
+# History-only fields are listed unconditionally; the analyzer filters
+# by mode.  Keep in sync with the k_* bodies above.
+TRANSFER_WRITES = {
+    SP.RESTART: ("role", "vResp", "vGrant", "nextIndex", "matchIndex",
+                 "commitIndex", "vLog"),
+    SP.TIMEOUT: ("role", "term", "votedFor", "vResp", "vGrant", "vLog"),
+    SP.REQUESTVOTE: ("msgHi", "msgLo", "msgCount"),
+    SP.BECOMELEADER: ("role", "nextIndex", "matchIndex",
+                      "eTerm", "eLeader", "eLog", "eVotes", "eVLog"),
+    SP.CLIENTREQUEST: ("logTerm", "logVal", "logLen"),
+    SP.ADVANCECOMMIT: ("commitIndex",),
+    SP.APPENDENTRIES: ("msgHi", "msgLo", "msgCount"),
+    SP.RECEIVE: ("term", "role", "votedFor", "vResp", "vGrant", "vLog",
+                 "commitIndex", "logTerm", "logVal", "logLen",
+                 "nextIndex", "matchIndex", "msgHi", "msgLo", "msgCount"),
+    SP.DUPLICATE: ("msgCount",),
+    SP.DROP: ("msgHi", "msgLo", "msgCount"),
+}
+
+# finish_expand's shared postlude writes (outside any single family):
+# the faithful-mode allLogs union — raw 32-bit mask words, or-only.
+POSTLUDE_WRITES = ("allLogs",)
+
+
+def transfer_metadata() -> dict:
+    """Per-family metadata for the static analyzer: parameter names and
+    declared write-sets.  Raises KeyError (loudly, at lint time) if the
+    two tables ever drift apart."""
+    out = {}
+    for fam, (_kern, params) in _FAMILY_KERNELS.items():
+        out[fam] = {"params": params, "writes": TRANSFER_WRITES[fam]}
+    for fam in TRANSFER_WRITES:
+        if fam not in _FAMILY_KERNELS:
+            raise KeyError(f"TRANSFER_WRITES names unknown family {fam}")
+    return out
+
 
 def group_instances(table):
     """Group contiguous instances of the same family for vectorized

@@ -51,11 +51,19 @@ interpreter share this module — one source of truth for the encoding).
 
 from __future__ import annotations
 
-# (shift, width) per field
-_HI_FIELDS = {"mtype": (0, 3), "mterm": (3, 6), "a": (9, 6), "b": (15, 6),
-              "src": (21, 4), "dst": (25, 4)}
-_LO_FIELDS = {"c": (0, 1), "d": (1, 6), "e": (7, 4), "f": (11, 6),
-              "g": (17, 14)}
+# (shift, width) per field — THE packed-record encoding.  Public: the
+# static analyzer (analysis/widthcheck) validates the tables (no overlap,
+# no spill past bit 31 — the int32 sign bit stays clear) and proves every
+# record-creation site writes subfields that fit them.  Mutating a width
+# here without re-deriving the proof is exactly the silent-truncation bug
+# class the analyzer exists to catch (tests/test_lint_mutations.py).
+HI_FIELDS = {"mtype": (0, 3), "mterm": (3, 6), "a": (9, 6), "b": (15, 6),
+             "src": (21, 4), "dst": (25, 4)}
+LO_FIELDS = {"c": (0, 1), "d": (1, 6), "e": (7, 4), "f": (11, 6),
+             "g": (17, 14)}
+# Historical private aliases (bitpack and older call sites).
+_HI_FIELDS = HI_FIELDS
+_LO_FIELDS = LO_FIELDS
 
 
 def pack_hi(mtype, mterm, a, b, src, dst):

@@ -19,11 +19,20 @@ of ``Server``/``Value`` becomes :class:`raft_tla_tpu.config.Bounds`
 registry.  Bound parameters (MaxTerm &c.) come from CLI/:class:`Bounds`, and
 ``models/tla_export.py`` emits the matching ``CONSTRAINT`` module for stock
 TLC parity runs.
+
+Diagnostics are load-bearing here: a typo'd stanza keyword or invariant name
+must fail *loudly at parse/resolve time* with the offending line number and
+the known names (unknown names silently checking nothing is the classic TLC
+footgun).  The parser records the source line of every name it reads
+(:attr:`TLCConfig.lines`) so both the hard-error path
+(:func:`resolve_names`, used by check.py) and the diagnostic path
+(analysis/cfglint Pass 2) can point at the exact line.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import difflib
 import re
 
 _STANZAS = (
@@ -55,6 +64,11 @@ class TLCConfig:
     constants: dict = dataclasses.field(default_factory=dict)
     symmetry: list[str] = dataclasses.field(default_factory=list)
     view: str | None = None
+    # (kind, name) -> 1-based source line, e.g. ("invariant", "NoTwoLeaders")
+    # -> 3.  Kinds: invariant, property, constraint, constant, symmetry,
+    # view, specification, init, next.  Diagnostics only; equality and the
+    # model mapping ignore it.
+    lines: dict = dataclasses.field(default_factory=dict, compare=False)
 
     def server_names(self) -> list[str]:
         v = self.constants.get("Server")
@@ -67,6 +81,9 @@ class TLCConfig:
         if not isinstance(v, list):
             raise ValueError("cfg does not bind Value to a finite set")
         return v
+
+    def line_of(self, kind: str, name: str) -> int | None:
+        return self.lines.get((kind, name))
 
 
 def _strip_comment(line: str) -> str:
@@ -94,7 +111,7 @@ def _parse_set(text: str) -> list[str]:
 def parse_cfg(text: str) -> TLCConfig:
     cfg = TLCConfig()
     mode: str | None = None
-    for raw in text.splitlines():
+    for lineno, raw in enumerate(text.splitlines(), start=1):
         line = _strip_comment(raw)
         if not line:
             continue
@@ -108,28 +125,43 @@ def parse_cfg(text: str) -> TLCConfig:
                 continue
         if mode in ("SPECIFICATION",):
             cfg.specification = line
+            cfg.lines[("specification", line)] = lineno
         elif mode == "INIT":
             cfg.init = line
+            cfg.lines[("init", line)] = lineno
         elif mode == "NEXT":
             cfg.next = line
+            cfg.lines[("next", line)] = lineno
         elif mode in ("INVARIANT", "INVARIANTS"):
-            cfg.invariants.extend(line.split())
+            for name in line.split():
+                cfg.invariants.append(name)
+                cfg.lines[("invariant", name)] = lineno
         elif mode in ("PROPERTY", "PROPERTIES"):
             # temporal FORMULAS (<>P, []<>P, P ~> Q) are one property
             # per line; bare names may share a line like INVARIANTS
             if "<>" in line or "~>" in line:
-                cfg.properties.append(" ".join(line.split()))
+                formula = " ".join(line.split())
+                cfg.properties.append(formula)
+                cfg.lines[("property", formula)] = lineno
             else:
-                cfg.properties.extend(line.split())
+                for name in line.split():
+                    cfg.properties.append(name)
+                    cfg.lines[("property", name)] = lineno
         elif mode in ("CONSTRAINT", "CONSTRAINTS"):
-            cfg.constraints.extend(line.split())
+            for name in line.split():
+                cfg.constraints.append(name)
+                cfg.lines[("constraint", name)] = lineno
         elif mode == "SYMMETRY":
-            cfg.symmetry.extend(line.split())
+            for name in line.split():
+                cfg.symmetry.append(name)
+                cfg.lines[("symmetry", name)] = lineno
         elif mode == "VIEW":
             cfg.view = line
+            cfg.lines[("view", line)] = lineno
         elif mode in ("CONSTANT", "CONSTANTS"):
             if "=" not in line:
-                raise ValueError(f"bad CONSTANTS binding: {raw!r}")
+                raise ValueError(
+                    f"line {lineno}: bad CONSTANTS binding: {raw!r}")
             name, _, val = line.partition("=")
             name, val = name.strip(), val.strip()
             # "<-" substitutions are not supported (not used by the reference).
@@ -137,9 +169,44 @@ def parse_cfg(text: str) -> TLCConfig:
                 cfg.constants[name] = _parse_set(val)
             else:
                 cfg.constants[name] = val.strip('"')
+            cfg.lines[("constant", name)] = lineno
         else:
-            raise ValueError(f"line outside any stanza: {raw!r}")
+            raise ValueError(
+                f"line {lineno}: line outside any stanza: {raw!r} "
+                f"(known stanzas: {', '.join(_STANZAS)})")
     return cfg
+
+
+def suggest(name: str, known) -> list[str]:
+    """Did-you-mean candidates for an unknown cfg name."""
+    return difflib.get_close_matches(name, sorted(known), n=3, cutoff=0.5)
+
+
+def unknown_names(names, known) -> list[tuple[str, list[str]]]:
+    """The subset of ``names`` not in ``known``, each with suggestions.
+    Non-raising — analysis/cfglint turns these into findings."""
+    known = set(known)
+    return [(n, suggest(n, known)) for n in names if n not in known]
+
+
+def resolve_names(names, known, kind: str, *, cfg: TLCConfig | None = None,
+                  path: str | None = None) -> list[str]:
+    """Validate cfg names against a registry, raising on the first unknown
+    with the offending source line, a did-you-mean, and the full registry
+    (shared by check.py config resolution and the Pass 2 lint)."""
+    bad = unknown_names(names, known)
+    if not bad:
+        return list(names)
+    name, hints = bad[0]
+    where = ""
+    if cfg is not None:
+        lineno = cfg.line_of(kind, name)
+        if lineno is not None:
+            where = f"{path or 'cfg'} line {lineno}: "
+    hint_txt = f" (did you mean: {', '.join(hints)}?)" if hints else ""
+    raise ValueError(
+        f"{where}unknown {kind} {name!r}{hint_txt}; "
+        f"known: {', '.join(sorted(known))}")
 
 
 def load_cfg(path: str) -> TLCConfig:

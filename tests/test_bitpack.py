@@ -79,6 +79,68 @@ def test_roundtrip_all_reachable_states():
     np.testing.assert_array_equal(out, vecs)
 
 
+# Satellite of the speclint PR: property-style coverage of field_bits at
+# the exact capacity edge.  n and max_log sweep the packing-sensitive
+# axes (votedFor/vResp widths track n; index widths track log_cap); the
+# faithful rows use bounds small enough for the 1024-entry log universe.
+EDGE_BOUNDS = [
+    Bounds(n_servers=3, max_log=2, history=False),
+    Bounds(n_servers=3, max_log=3, history=False),
+    Bounds(n_servers=5, max_log=2, history=False),
+    Bounds(n_servers=5, max_log=3, history=False),
+    Bounds(n_servers=3, n_values=1, max_term=2, max_log=2, history=True),
+    Bounds(n_servers=5, n_values=1, max_term=2, max_log=3, history=True),
+]
+
+
+@pytest.mark.parametrize("bounds", EDGE_BOUNDS)
+def test_exact_maxima_roundtrip(bounds):
+    """Every position at exactly its field maximum survives pack→unpack —
+    the widths field_bits allots really hold their extreme value."""
+    schema = bitpack.BitSchema(bounds)
+    mx = _max_per_position(schema)
+    # One vector per position: that position at max, others at 0; plus
+    # the all-max corner (cross-field carry/straddle interactions).
+    vecs = np.zeros((schema.W + 1, schema.W), dtype=np.int64)
+    np.fill_diagonal(vecs[:schema.W], mx)
+    vecs[schema.W] = mx
+    vecs = vecs.astype(np.int32)
+    out = schema.unpack(schema.pack(vecs, np), np)
+    np.testing.assert_array_equal(out, vecs)
+
+
+@pytest.mark.parametrize("bounds", EDGE_BOUNDS)
+def test_one_past_maximum_truncates(bounds):
+    """One past the maximum is NOT representable: pack masks it and the
+    round-trip visibly differs — the truncation the static analyzer
+    (analysis/widthcheck) proves no kernel can trigger."""
+    schema = bitpack.BitSchema(bounds)
+    for w in range(schema.W):
+        bits = int(schema.bits[w])
+        if bits >= 31:
+            continue                     # 1<<31 overflows int32: raw field
+        vec = np.zeros((1, schema.W), dtype=np.int32)
+        vec[0, w] = np.int32(1 << bits)
+        out = schema.unpack(schema.pack(vec, np), np)
+        assert out[0, w] == 0, f"position {w} did not truncate"
+        assert not np.array_equal(out, vec)
+
+
+@pytest.mark.parametrize("bounds", EDGE_BOUNDS)
+def test_width_table_consistent(bounds):
+    """width_table is the analyzer's contract: it must agree with the
+    BitSchema actually used to pack rows."""
+    table = bitpack.width_table(bounds)
+    schema = bitpack.BitSchema(bounds)
+    lay = st.Layout.of(bounds)
+    assert table["total_bits"] == schema.total_bits
+    assert table["packed_words"] == schema.P
+    assert table["flat_words"] == lay.width
+    assert set(table["bits"]) == set(lay.fields)
+    for f in table["raw"]:
+        assert table["bits"][f] == 32
+
+
 def test_density_on_flagship_layout():
     bounds = Bounds(n_servers=3, n_values=2, max_term=2, max_log=1,
                     max_msgs=2)

@@ -1,0 +1,198 @@
+"""Seeded-mutation harness: the analyzer has NO false negatives on the
+overflow/hazard bug classes it exists to catch.
+
+Each test plants one deliberate bug — a kernel-level width regression
+(via the analyzer's injectable transfers/tables, the same seams the real
+kernels feed through) or a tracer-hostile source idiom — and asserts the
+analyzer flags it.  Width findings must carry the full proof context:
+transition, field, derived interval, and allotted width (the acceptance
+contract).  A mutation the analyzer misses is a failing test.
+"""
+
+import pytest
+
+from raft_tla_tpu.analysis import intervals as iv, jitlint, report
+from raft_tla_tpu.analysis import widthcheck as wc
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.ops import bitpack, msgbits
+
+
+def _assert_proof_fields(finding):
+    """Every width finding reports transition, field, interval, width."""
+    assert finding.pass_ == report.WIDTH
+    assert finding.severity == report.ERROR
+    assert finding.field is not None
+    assert finding.interval is not None
+    assert finding.width is not None
+
+
+# -- mutation 1: Timeout increments term twice (cap clamp lost) --------------
+
+def test_mutation_timeout_double_increment():
+    mut = dict(wc.TRANSFERS)
+
+    def bad_timeout(bounds, env, menv):
+        r = wc.t_timeout(bounds, env, menv)
+        writes = dict(r.writes)
+        writes["term"] = env["term"] + 2          # skips a term
+        return wc.TransferResult(writes, r.sends)
+
+    mut["Timeout"] = bad_timeout
+    fs = wc.check_widths(Bounds(), transfers=mut)
+    hits = [f for f in fs if f.transition == "Timeout" and f.field == "term"]
+    assert hits, fs
+    for f in hits:
+        _assert_proof_fields(f)
+        assert f.transition == "Timeout"
+    # term_cap = max_term + 1 = 4: [1,3] + 2 escapes the [1,4] envelope.
+    assert any(f.interval == (3, 5) for f in hits)
+
+
+# -- mutation 2: expansion without the constraint meet (capacity scheme) -----
+
+def test_mutation_unconstrained_expansion():
+    """Dropping the StateConstraint meet is the whole +1 capacity scheme
+    failing: Timeout, ClientRequest, and Duplicate must ALL overflow."""
+    fs = wc.check_widths(Bounds(), expansion_env=iv.envelope(Bounds()))
+    by_transition = {(f.transition, f.field) for f in fs}
+    assert ("Timeout", "term") in by_transition
+    assert ("ClientRequest", "logLen") in by_transition
+    assert ("DuplicateMessage", "msgCount") in by_transition
+    overflow = [f for f in fs if f.code == "width-overflow"]
+    assert any(f.transition == "ClientRequest" and f.field == "logLen"
+               for f in overflow)
+    for f in fs:
+        if f.code in ("width-overflow", "envelope-escape"):
+            _assert_proof_fields(f)
+
+
+# -- mutation 3: msgLo 'g' widened one bit (spill past bit 31) ---------------
+
+def test_mutation_msglo_widened_spills():
+    lo = dict(msgbits.LO_FIELDS)
+    sh, w = lo["g"]
+    lo["g"] = (sh, w + 1)                        # 17 + 15 = 32 > 31
+    fs = wc.check_widths(Bounds(history=True), lo_fields=lo)
+    [f] = [f for f in fs if f.code == "msg-table-spill"]
+    assert f.field == "msgLo.g" and f.severity == report.ERROR
+
+
+def test_mutation_overlapping_subfields():
+    hi = dict(msgbits.HI_FIELDS)
+    sh, w = hi["mterm"]
+    hi["mterm"] = (sh, w + 4)                    # grows into field 'a'
+    fs = wc.check_widths(Bounds(), hi_fields=hi)
+    assert any(f.code == "msg-table-overlap" for f in fs), fs
+
+
+# -- mutation 4: record subfield slot narrowed (creation-site overflow) ------
+
+def test_mutation_mterm_slot_narrowed():
+    hi = dict(msgbits.HI_FIELDS)
+    sh, _w = hi["mterm"]
+    hi["mterm"] = (sh, 1)                        # terms reach 3 > 1 bit
+    fs = wc.check_widths(Bounds(), hi_fields=hi)
+    hits = [f for f in fs if f.code == "msg-subfield-overflow"
+            and f.field.endswith(".mterm")]
+    assert hits, fs
+    for f in hits:
+        _assert_proof_fields(f)
+        assert f.transition is not None          # names the creating action
+    assert any("RequestVote" in f.transition for f in hits)
+
+
+# -- mutation 5: flat field width narrowed by one bit ------------------------
+
+def test_mutation_field_bits_narrowed():
+    fb = dict(bitpack.field_bits(Bounds()))
+    fb["term"] -= 1
+    fs = wc.check_widths(Bounds(), field_bits_table=fb)
+    codes = {f.code for f in fs}
+    assert "envelope-width" in codes             # envelope no longer fits
+    overflow = [f for f in fs if f.code == "width-overflow"
+                and f.field == "term"]
+    assert overflow, fs
+    for f in overflow:
+        _assert_proof_fields(f)
+        assert f.width == fb["term"]
+
+
+# -- mutation 6: kernel/twin write-set drift ---------------------------------
+
+def test_mutation_transfer_drift_detected():
+    mut = dict(wc.TRANSFERS)
+
+    def sneaky_advance(bounds, env, menv):
+        r = wc.t_advance_commit(bounds, env, menv)
+        writes = dict(r.writes)
+        writes["matchIndex"] = env["matchIndex"]   # undeclared write
+        return wc.TransferResult(writes, r.sends)
+
+    mut["AdvanceCommitIndex"] = sneaky_advance
+    fs = wc.check_widths(Bounds(), transfers=mut)
+    [f] = [f for f in fs if f.code == "transfer-drift"]
+    assert f.transition == "AdvanceCommitIndex"
+    assert f.field == "matchIndex"
+
+
+# -- mutation 7: parity mode leaks mlog into a packed record -----------------
+
+def test_mutation_parity_mlog_leak():
+    mut = dict(wc.TRANSFERS)
+
+    def leaky_rv(bounds, env, menv):
+        r = wc.t_request_vote(bounds, env, menv)
+        rec = r.sends[0]
+        fields = dict(rec.fields)
+        fields["g"] = iv.Interval(0, 5)           # history data in parity
+        rec2 = wc.MsgRecord(rec.mtype, fields)
+        return wc.TransferResult(r.writes, (rec2,))
+
+    mut["RequestVote"] = leaky_rv
+    fs = wc.check_widths(Bounds(history=False), transfers=mut)
+    [f] = [f for f in fs if f.code == "parity-mlog-nonzero"]
+    assert f.transition == "RequestVote"
+    assert f.interval == (0, 5)
+
+
+# -- mutation 8: tracer-hostile idioms planted in source ---------------------
+
+HAZARD_SNIPPETS = {
+    "traced-python-if": (
+        "import jax.numpy as jnp\n"
+        "def k_receive(bounds, s, slot):\n"
+        "    if s['msgHi'][slot] > 0:\n"         # traced guard
+        "        return jnp.ones(())\n"
+        "    return jnp.zeros(())\n"),
+    "traced-scalar-cast": (
+        "import jax.numpy as jnp\n"
+        "def k_timeout(s, i):\n"
+        "    t = int(s['term'][i]) + 1\n"        # concretizes the tracer
+        "    return jnp.asarray(t)\n"),
+    "set-iteration": (
+        "def build_table():\n"
+        "    rows = []\n"
+        "    for fam in {'Restart', 'Timeout'}:\n"   # salted order
+        "        rows.append(fam)\n"
+        "    return rows\n"),
+    "narrow-astype": (
+        "import jax.numpy as jnp\n"
+        "def pack_rows(v):\n"
+        "    return v.astype(jnp.int16)\n"),     # no width justification
+}
+
+
+@pytest.mark.parametrize("code", sorted(HAZARD_SNIPPETS))
+def test_mutation_jit_hazards_flagged(code):
+    fs = jitlint.lint_source(HAZARD_SNIPPETS[code], f"mut_{code}.py")
+    assert [f.code for f in fs] == [code]
+    [f] = fs
+    assert f.severity == report.WARNING
+    assert f.file == f"mut_{code}.py" and f.line is not None
+
+
+def test_no_mutation_no_findings():
+    """Control: with nothing planted, the analyzer stays silent — the
+    mutations above are detected, not hallucinated."""
+    assert wc.check_widths(Bounds()) == []
+    assert wc.check_widths(Bounds(history=True)) == []

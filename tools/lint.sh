@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Pre-push gate: the speclint static analyzer plus a pytest collection
+# sanity pass.  Fast (no model checking, no kernel compiles beyond the
+# analyzer's own imports) — run it before every push:
+#
+#     tools/lint.sh            # both encoding modes, flagship cfg
+#     tools/lint.sh --strict   # warnings fail too
+#
+# Exits nonzero if the analyzer reports an error (or, with --strict, any
+# finding), or if the smoke-marked test set no longer collects.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== speclint (width + cfg + jit passes, parity & faithful) =="
+python -m raft_tla_tpu.lint runs/MC3s2v.cfg "$@"
+
+echo "== pytest smoke collection =="
+python -m pytest tests/ -m smoke --collect-only -q -p no:cacheprovider \
+    --continue-on-collection-errors | tail -2
