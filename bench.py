@@ -31,6 +31,7 @@ Prints exactly one JSON line on stdout; human detail goes to stderr.
 """
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -111,7 +112,6 @@ def run_fiducial() -> None:
     ceiling.
     """
     import math
-    import os
 
     # pin the orbit-scan program: policy changes must not move the fiducial
     os.environ["RAFT_TLA_PRESCAN"] = "off"
@@ -307,6 +307,18 @@ def main() -> None:
           f"{fid['words_per_sec']:,.0f} orbit-words/s "
           f"({fid['pct_vpu_peak']:.1f}% of measured VPU ceiling)",
           file=sys.stderr)
+    events_path = os.environ.get("RAFT_TLA_EVENTS")
+    if events_path:
+        # chip-weather evidence into the campaign's event log: the
+        # monitor reads fiducials off run_start events to report drift
+        try:
+            from raft_tla_tpu.obs.events import append_event, git_sha
+            append_event(events_path, "run_start", engine="bench",
+                         universe={}, spec="fiducial", invariants=[],
+                         resumed=False, fiducials=fid,
+                         **({"git_sha": git_sha()} if git_sha() else {}))
+        except Exception as e:      # evidence channel, never the verdict
+            print(f"bench: event append failed: {e!r}", file=sys.stderr)
 
     # -- part 1: the north-star probe --------------------------------------
     ns = _child(["--northstar"], timeout=480, what="northstar")

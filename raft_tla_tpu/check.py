@@ -216,6 +216,21 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--stats", action="store_true",
                    help="emit one JSON line of run stats per search segment "
                         "on stderr (device/paged/shard engines)")
+    p.add_argument("--events", metavar="PATH",
+                   help="append the versioned JSONL run-event log "
+                        "(run_start/segment/level_end/checkpoint/"
+                        "violation/run_end — obs/events.py) to PATH; "
+                        "tail it live with raft-tla-monitor. Sets "
+                        "RAFT_TLA_EVENTS process-wide so liveness "
+                        "re-runs inherit the same log")
+    p.add_argument("--phase-timers", action="store_true",
+                   help="attribute wall time to search phases (upload/"
+                        "expand/export/dedup/snapshot) in each segment "
+                        "event, at the cost of a device sync per phase — "
+                        "the ddd engines lose their two-deep dispatch "
+                        "overlap while this is on. Off by default so jit "
+                        "pipelining is untouched; also RAFT_TLA_"
+                        "PHASE_TIMERS=1")
     p.add_argument("--simulate", type=int, metavar="N", default=None,
                    help="TLC -simulate analog: instead of exhaustive "
                         "search, sample N random behaviors (batched "
@@ -531,6 +546,20 @@ def main(argv=None) -> int:
     if args.stats and args.engine not in _DEVICE_ENGINES:
         p.error(f"--stats requires a device-class engine "
                 f"(got {args.engine})")
+    if (args.events or args.phase_timers) and \
+            args.engine not in _DEVICE_ENGINES:
+        p.error(f"--events/--phase-timers require a device-class engine "
+                f"(got {args.engine}); other engines emit no run events")
+    if args.events or args.phase_timers:
+        # Process-wide, like --sig-prune: every engine an invocation
+        # builds (including liveness re-runs) reads the same env gate.
+        import os
+        from raft_tla_tpu.obs.events import ENV_EVENTS
+        from raft_tla_tpu.obs.phases import ENV_PHASE_TIMERS
+        if args.events:
+            os.environ[ENV_EVENTS] = args.events
+        if args.phase_timers:
+            os.environ[ENV_PHASE_TIMERS] = "1"
     try:
         config, props = _resolve_config(args)
     except (OSError, ValueError) as e:

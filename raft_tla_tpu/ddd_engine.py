@@ -68,6 +68,7 @@ from raft_tla_tpu.device_engine import (
     aggregate_coverage, decode_fail)
 from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
+from raft_tla_tpu.obs import RunTelemetry
 from raft_tla_tpu.ops import bitpack
 from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
@@ -979,7 +980,8 @@ class DDDEngine:
               checkpoint_every_s: float = 600.0,
               resume: str | None = None,
               deadline_s: float | None = None,
-              retain_store: bool = False) -> EngineResult:
+              retain_store: bool = False,
+              events: str | None = None) -> EngineResult:
         import contextlib
         with contextlib.ExitStack() as stack:
             # bound stack: tmpdir cleanup runs on EVERY exit, including
@@ -988,7 +990,7 @@ class DDDEngine:
             return self._check_impl(
                 init_override, on_progress, checkpoint,
                 checkpoint_every_s, resume, deadline_s, retain_store,
-                stack)
+                stack, events)
 
     def _install_sigint(self, stack) -> None:
         """The runs/campaign_stop.sh contract: the FIRST SIGINT sets a
@@ -1024,8 +1026,13 @@ class DDDEngine:
 
     def _check_impl(self, init_override, on_progress, checkpoint,
                     checkpoint_every_s, resume, deadline_s,
-                    retain_store, _cleanup) -> EngineResult:
+                    retain_store, _cleanup, events=None) -> EngineResult:
         t0 = time.monotonic()
+        tel = RunTelemetry(
+            "ddd", config=self.config, caps=self.caps,
+            on_progress=on_progress, events=events,
+            resumed=resume is not None, n0=1, t0=t0)
+        _cleanup.callback(tel.close)
         bounds = self.bounds
         init_py = init_override if init_override is not None \
             else interp.init_state(bounds)
@@ -1035,11 +1042,13 @@ class DDDEngine:
         for nm in self.config.invariants:
             if not inv_mod.py_invariant(nm)(init_py, bounds):
                 from collections import Counter
-                return EngineResult(
+                res = EngineResult(
                     n_states=1, diameter=0, n_transitions=0,
                     coverage=Counter(),
                     violation=Violation(nm, init_py, [(None, init_py)]),
                     levels=[1], wall_s=time.monotonic() - t0)
+                tel.run_end(res)
+                return res
 
         B = self.config.chunk
         N = B * self.A
@@ -1140,37 +1149,22 @@ class DDDEngine:
                                     self.SEG_CLAMP_S)
         budget = pacer.budget
         last_ckpt = time.monotonic()
-
-        prev = {"wall": 0.0, "n": n_states}   # incremental-rate anchor
+        tel.run_start(n_states=n_states)
 
         def progress():
-            if on_progress is None:
+            if not tel.active:
                 return
-            wall = time.monotonic() - t0
-            # anchor on the same inclusive count the n_states field
-            # reports (ADVICE r4): bare n_states advances only at
-            # flushes, which read as a 0-then-spike rate artifact
+            # report the same inclusive count the old stats stream did
+            # (ADVICE r4): bare n_states advances only at flushes, which
+            # read as a 0-then-spike rate artifact; the tracker anchors
+            # its incremental rate on the running max of this count, so a
+            # post-flush dip never reads as a negative rate
             n_incl = n_states + sum(len(k) for k in pend["keys"])
-            # rate anchors on the running max: the inclusive count is
-            # non-monotone (pend is pre-dedup), and a post-flush dip
-            # must not read as a negative rate
-            anchor = max(prev["n"], n_incl)
-            dn, dw = anchor - prev["n"], wall - prev["wall"]
-            prev.update(wall=wall, n=anchor)
-            on_progress({
-                "wall_s": round(wall, 3),
-                "n_states": n_incl,                  # upper bound
-                "level": len(level_ends),
-                "n_transitions": n_trans,
-                "dedup_hit_rate": round(
-                    max(0.0, 1.0 - n_states / max(n_trans, 1)), 4),
-                # CUMULATIVE (inflates after resume — kept for
-                # cross-round comparability); inc_* is the honest rate
-                "states_per_sec": round(n_states / max(wall, 1e-9), 1),
-                "inc_states_per_sec": round(dn / max(dw, 1e-9), 1),
-                "route_peak": route_peak,
-                "coverage": dict(aggregate_coverage(self.table, cov)),
-            })
+            tel.segment(
+                n_states=n_states, n_incl=n_incl,
+                level=len(level_ends), n_transitions=n_trans,
+                coverage=dict(aggregate_coverage(self.table, cov)),
+                route_peak=route_peak)
 
         while not stopped:
             lvl_lo = level_ends[-2] if len(level_ends) > 1 else 0
@@ -1178,15 +1172,16 @@ class DDDEngine:
             for b_start in range(lvl_lo + blocks_done * Fcap, lvl_hi,
                                  Fcap):
                 b_rows = min(Fcap, lvl_hi - b_start)
-                blk = host.read(b_start, b_rows)
-                con = constore.read(b_start, b_rows)[:, 0].astype(bool)
-                if b_rows < Fcap:
-                    blk = np.concatenate([blk, np.zeros(
-                        (Fcap - b_rows, self.schema.P), np.int32)])
-                    con = np.concatenate(
-                        [con, np.zeros((Fcap - b_rows,), bool)])
-                fbuf = jnp.asarray(blk)
-                fcon = jnp.asarray(con)
+                with tel.phases.phase("upload") as ph:
+                    blk = host.read(b_start, b_rows)
+                    con = constore.read(b_start, b_rows)[:, 0].astype(bool)
+                    if b_rows < Fcap:
+                        blk = np.concatenate([blk, np.zeros(
+                            (Fcap - b_rows, self.schema.P), np.int32)])
+                        con = np.concatenate(
+                            [con, np.zeros((Fcap - b_rows,), bool)])
+                    fbuf, fcon = ph.sync((jnp.asarray(blk),
+                                          jnp.asarray(con)))
                 fc = fc._replace(c=jnp.int32(0))
                 # Two-deep segment pipeline: segment k+1 depends on k only
                 # through the filter carry, so it is dispatched BEFORE k's
@@ -1211,15 +1206,22 @@ class DDDEngine:
                             and time.monotonic() - t_warm > deadline_s):
                         complete = False
                         stopped = True
+                        tel.stop_requested("deadline")
                     if not stopped and self._sigint:
                         complete = False      # graceful-stop contract:
                         stopped = True        # flush+snapshot below
+                        tel.stop_requested("sigint")
                     if not (block_done or stopped) and free:
                         idx = free.pop(0)
                         t_disp = time.monotonic()
-                        fc, bufsets[idx], stats = self._segment(
-                            fc, bufsets[idx], fbuf, fcon,
-                            jnp.int32(budget), jnp.int32(b_rows))
+                        # enabling phase timers blocks on each dispatch —
+                        # honest per-phase walls at the cost of the
+                        # two-deep overlap (obs/phases.py contract)
+                        with tel.phases.phase("expand") as ph:
+                            fc, bufsets[idx], stats = self._segment(
+                                fc, bufsets[idx], fbuf, fcon,
+                                jnp.int32(budget), jnp.int32(b_rows))
+                            ph.sync(stats)
                         q.append((idx, stats, t_disp))
                         if len(q) < 2:
                             continue         # keep the pipeline full
@@ -1236,12 +1238,13 @@ class DDDEngine:
                     # the 8 s segment target the fixed transfer is a few
                     # percent; zero-stream segments (every block end) now
                     # skip it entirely.
-                    st_h = jax.device_get(stats)
-                    ns, nv = int(st_h.cursor), int(st_h.n_valid)
-                    vk = int(st_h.viol_kind)
-                    route_peak = max(route_peak, int(st_h.peak))
-                    bufs_h = jax.device_get(bufsets[idx]) \
-                        if ns and not stopped else None
+                    with tel.phases.phase("export"):
+                        st_h = jax.device_get(stats)
+                        ns, nv = int(st_h.cursor), int(st_h.n_valid)
+                        vk = int(st_h.viol_kind)
+                        route_peak = max(route_peak, int(st_h.peak))
+                        bufs_h = jax.device_get(bufsets[idx]) \
+                            if ns and not stopped else None
                     free.append(idx)
                     if stopped:
                         continue             # drop post-stop segments
@@ -1291,8 +1294,10 @@ class DDDEngine:
                     block_done = block_done or bool(st_h.done)
                     if sum(len(x) for x in pend["keys"]) >= \
                             self.caps.flush:
-                        n_states += self._flush(pend, master, host,
-                                                constore, keystore, cov)
+                        with tel.phases.phase("dedup"):
+                            n_states += self._flush(pend, master, host,
+                                                    constore, keystore,
+                                                    cov)
                         if n_states > _IDX_CEIL:
                             fail = FAIL_INDEX
                             stopped = True
@@ -1306,18 +1311,22 @@ class DDDEngine:
                 blocks_done += 1
                 if checkpoint and (time.monotonic() - last_ckpt
                                    >= checkpoint_every_s):
-                    n_states += self._flush(pend, master, host, constore,
-                                            keystore, cov)
-                    self.save_checkpoint(checkpoint, host, constore,
-                                         keystore, n_states, n_trans,
-                                         cov, level_ends, blocks_done,
-                                         (hi0, lo0))
+                    with tel.phases.phase("dedup"):
+                        n_states += self._flush(pend, master, host,
+                                                constore, keystore, cov)
+                    with tel.phases.phase("snapshot"):
+                        self.save_checkpoint(checkpoint, host, constore,
+                                             keystore, n_states, n_trans,
+                                             cov, level_ends, blocks_done,
+                                             (hi0, lo0))
+                    tel.checkpoint(checkpoint, n_states)
                     last_ckpt = time.monotonic()
             if stopped:
                 break
             blocks_done = 0
-            n_states += self._flush(pend, master, host, constore,
-                                    keystore, cov)
+            with tel.phases.phase("dedup"):
+                n_states += self._flush(pend, master, host, constore,
+                                        keystore, cov)
             progress()
             if n_states > _IDX_CEIL:
                 fail = FAIL_INDEX
@@ -1341,15 +1350,18 @@ class DDDEngine:
                     f"DDD search aborted: {decode_fail(FAIL_LEVEL)} "
                     f"(caps={self.caps}) — grow DDDCapacities and rerun")
 
-        n_states += self._flush(pend, master, host, constore, keystore,
-                                cov)
+        with tel.phases.phase("dedup"):
+            n_states += self._flush(pend, master, host, constore, keystore,
+                                    cov)
         if self._sigint and checkpoint and not viol and not fail:
             # graceful SIGINT stop: same mid-level snapshot shape as the
             # periodic path above (pend flushed first, so re-running the
             # partial block on resume dedups against the master keys)
-            self.save_checkpoint(checkpoint, host, constore, keystore,
-                                 n_states, n_trans, cov, level_ends,
-                                 blocks_done, (hi0, lo0))
+            with tel.phases.phase("snapshot"):
+                self.save_checkpoint(checkpoint, host, constore, keystore,
+                                     n_states, n_trans, cov, level_ends,
+                                     blocks_done, (hi0, lo0))
+            tel.checkpoint(checkpoint, n_states)
         if fail:
             _cleanup.close()
             raise RuntimeError(
@@ -1423,12 +1435,14 @@ class DDDEngine:
             host.close()
             constore.close()
             keystore.close()
-        _cleanup.close()
-        return EngineResult(
+        result = EngineResult(
             n_states=n_states, diameter=len(levels_arr) - 1,
             n_transitions=n_trans, coverage=coverage,
             violation=violation, levels=levels_arr,
             wall_s=time.monotonic() - t0, complete=complete)
+        tel.run_end(result)
+        _cleanup.close()
+        return result
 
 
 def check(config: CheckConfig, caps: DDDCapacities | None = None,

@@ -63,6 +63,7 @@ import numpy as np
 
 from raft_tla_tpu.config import CheckConfig
 from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
+from raft_tla_tpu.obs import RunTelemetry
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import fingerprint as fpr
 from raft_tla_tpu.ops import kernels
@@ -477,33 +478,6 @@ def aggregate_coverage(table, cov) -> Counter:
     return out
 
 
-def _progress_stats(carry: Carry, t0: float, table=None) -> dict:
-    """One batched transfer of the run's live counters (SURVEY §5).
-
-    With ``table`` (the engine's action table) the dict also carries the
-    live per-action-family coverage — TLC's ``-coverage 1`` minute-ticker
-    analog (/root/reference/.vscode/settings.json:4), here per segment."""
-    n_states, lvl, n_trans, cov = jax.device_get(
-        (carry.n_states, carry.lvl, carry.n_trans, carry.cov))
-    wall = time.monotonic() - t0
-    n_states, n_trans = int(n_states), acc64_int(n_trans)
-    out = {
-        "wall_s": round(wall, 3),
-        "n_states": n_states,
-        "level": int(lvl),
-        "n_transitions": n_trans,
-        # fraction of explored transitions that landed on an already-
-        # discovered state (n_states includes Init, so the earliest
-        # segments skew slightly; clamped at 0)
-        "dedup_hit_rate": round(max(0.0, 1.0 - n_states / max(n_trans, 1)),
-                                4),
-        "states_per_sec": round(n_states / max(wall, 1e-9), 1),
-    }
-    if table is not None:
-        out["coverage"] = dict(aggregate_coverage(table, cov))
-    return out
-
-
 class DeviceEngine:
     """One compiled exhaustive checker; reusable across runs."""
 
@@ -569,11 +543,16 @@ class DeviceEngine:
               checkpoint: str | None = None,
               checkpoint_every_s: float = 600.0,
               resume: str | None = None,
-              on_progress=None, retain_carry: bool = False) -> EngineResult:
-        """``on_progress``, if given, is called after every segment with a
-        dict of structured run stats (SURVEY §5 observability): wall
-        seconds, states found, BFS level, transitions, dedup hit rate,
-        throughput.  Costs one extra scalar transfer per segment.
+              on_progress=None, retain_carry: bool = False,
+              events: str | None = None) -> EngineResult:
+        """``on_progress``, if given, is called after every segment with the
+        shared :class:`~raft_tla_tpu.obs.ProgressRecord` dict (SURVEY §5
+        observability): wall seconds, states found, BFS level, transitions,
+        dedup hit rate, cumulative + incremental throughput, live
+        per-action-family coverage — TLC's ``-coverage 1`` minute-ticker
+        analog, here per segment.  ``events`` (or ``RAFT_TLA_EVENTS``)
+        additionally streams the versioned run-event log (obs/events.py).
+        Either costs one extra batched transfer per segment.
 
         ``retain_carry=True`` keeps the final carry on ``self.retained_carry``
         (store/conflag for post-hoc passes, e.g. liveness graph export —
@@ -581,20 +560,36 @@ class DeviceEngine:
         in HBM until the caller sets ``retained_carry = None``; a second
         ``check`` on the same engine allocates a fresh carry alongside."""
         t0 = time.monotonic()
+        tel = RunTelemetry(
+            "device", config=self.config, caps=self.caps,
+            on_progress=on_progress, events=events,
+            resumed=resume is not None,
+            n0=1 if resume is None else None, t0=t0)
+        try:
+            return self._check_impl(tel, t0, init_override, checkpoint,
+                                    checkpoint_every_s, resume, retain_carry)
+        finally:
+            tel.close()
+
+    def _check_impl(self, tel, t0, init_override, checkpoint,
+                    checkpoint_every_s, resume, retain_carry) -> EngineResult:
         bounds = self.bounds
         init_py = init_override if init_override is not None \
             else interp.init_state(bounds)
         init_vec = interp.to_vec(init_py, bounds)
         hi0, lo0 = sym_mod.init_fingerprint(self.config, init_py,
                                             init_vec)
+        tel.run_start()
 
         for nm in self.config.invariants:
             if not inv_mod.py_invariant(nm)(init_py, bounds):
-                return EngineResult(
+                res = EngineResult(
                     n_states=1, diameter=0, n_transitions=0,
                     coverage=Counter(),
                     violation=Violation(nm, init_py, [(None, init_py)]),
                     levels=[1], wall_s=time.monotonic() - t0)
+                tel.run_end(res)
+                return res
 
         args = (jnp.asarray(init_vec, I32), jnp.uint32(hi0), jnp.uint32(lo0),
                 jnp.bool_(interp.constraint_ok(init_py, bounds)))
@@ -614,15 +609,26 @@ class DeviceEngine:
         last_ckpt = time.monotonic()
         while True:
             t_seg = time.monotonic()
-            carry, done = self._segment(carry, jnp.int32(budget))
-            if on_progress is not None:
-                on_progress(_progress_stats(carry, t0, self.table))
+            with tel.phases.phase("expand") as ph:
+                carry, done = self._segment(carry, jnp.int32(budget))
+                ph.sync(done)
+            if tel.active:
+                with tel.phases.phase("export") as ph:
+                    n_states, lvl, n_trans, cov = jax.device_get(
+                        (carry.n_states, carry.lvl, carry.n_trans,
+                         carry.cov))
+                tel.segment(
+                    n_states=int(n_states), level=int(lvl),
+                    n_transitions=acc64_int(n_trans),
+                    coverage=dict(aggregate_coverage(self.table, cov)))
             if bool(done):
                 break
             dt = time.monotonic() - t_seg
             if checkpoint and (time.monotonic() - last_ckpt
                                >= checkpoint_every_s):
-                self.save_checkpoint(checkpoint, carry, (hi0, lo0))
+                with tel.phases.phase("snapshot"):
+                    self.save_checkpoint(checkpoint, carry, (hi0, lo0))
+                tel.checkpoint(checkpoint)
                 last_ckpt = time.monotonic()
             # this segment loop has no executed-chunk count; the requested
             # budget only underestimates chunk cost on early-exiting final
@@ -658,7 +664,7 @@ class DeviceEngine:
         if viol_g >= 0:
             violation = self._extract_trace(out, viol_g)
 
-        return EngineResult(
+        result = EngineResult(
             n_states=n_states,
             diameter=len(levels_arr) - 1,
             n_transitions=int(out["n_transitions"]),
@@ -666,6 +672,8 @@ class DeviceEngine:
             violation=violation,
             levels=levels_arr,
             wall_s=time.monotonic() - t0)
+        tel.run_end(result)
+        return result
 
     def _extract_trace(self, out, viol_g: int) -> Violation:
         """Two extra transfers: parent/lane links, then the chain's rows."""

@@ -1,0 +1,26 @@
+"""obs/ — unified run-event telemetry for every engine family.
+
+One schema, five engine families, three layers:
+
+- ``events``  — the versioned JSONL run-event log (``run_start`` /
+  ``segment`` / ``level_end`` / ``checkpoint`` / ``violation`` /
+  ``stop_requested`` / ``run_end``), the shared ``ProgressRecord``
+  payload that replaced the divergent per-engine ``on_progress`` dicts,
+  and the ``RunTelemetry`` facade the engines drive.
+- ``phases``  — device-sync-aware phase timers (off by default so the
+  engines' async-dispatch pipelining is untouched).
+- ``monitor`` — log reader + one-line campaign heartbeat
+  (``raft-tla-monitor``); imported lazily so engine processes never pay
+  for it.
+"""
+
+from raft_tla_tpu.obs.events import (  # noqa: F401
+    SCHEMA_VERSION,
+    EventLog,
+    ProgressRecord,
+    ProgressTracker,
+    RunTelemetry,
+    append_event,
+    validate_event,
+)
+from raft_tla_tpu.obs.phases import PhaseTimers  # noqa: F401

@@ -1,0 +1,509 @@
+"""Versioned JSONL run-event telemetry — the one progress schema every
+engine family emits.
+
+Before this module each engine family grew its own ad-hoc ``on_progress``
+dict (device/paged's ``_progress_stats``, streamed's method of the same
+name, the shard engines' ``n_devices`` variant, and the ddd engines'
+``progress()`` closures with their incremental-rate anchors).  Campaign
+state then lived in hand-rolled ``runs/*.stats`` streams plus an
+undocumented ``.telemetry`` column format, and a resumed run's cumulative
+``states_per_sec`` silently inflated (prior-process states / this-process
+wall).  This module replaces all of that with:
+
+- :class:`ProgressRecord` — one dataclass carrying cumulative counters
+  *and* incremental (honest-rate) counters, plus the dedup-hit-rate and
+  route-peak fields the ddd engines already computed.
+- :class:`ProgressTracker` — the rate/anchor arithmetic in one place:
+  ``inc_states_per_sec`` is primary (delta since the last record, immune
+  to resume inflation); cumulative fields are tagged ``since_resume``
+  (False = the counters span prior processes, so cumulative rates mix
+  prior-process states with this-process wall and are NOT trustworthy).
+- :class:`EventLog` — a non-blocking buffered JSONL writer (background
+  thread; ``emit`` never blocks the check loop).
+- :class:`RunTelemetry` — the facade engines drive: ``run_start`` /
+  ``segment`` / ``checkpoint`` / ``stop_requested`` / ``run_end``, with
+  ``level_end`` derived automatically from level transitions and
+  ``violation`` derived from the final :class:`~raft_tla_tpu.engine.EngineResult`.
+
+Event grammar (``SCHEMA_VERSION`` = 1) — every line is one JSON object
+with base fields ``v`` (schema version), ``event`` (type) and ``ts``
+(unix epoch seconds):
+
+``run_start``      engine, universe, spec, invariants, resumed
+                   [+ bounds, symmetry, view, chunk, caps, n_states,
+                      n_devices, git_sha, fiducials, pid]
+``segment``        the ProgressRecord fields (below)
+``level_end``      level, n_states           (as observed at a boundary)
+``checkpoint``     path [+ n_states]
+``violation``      invariant [+ kind]
+``stop_requested`` reason [+ source, pid]    (clean stop vs crash vs abort)
+``run_end``        n_states, n_transitions, complete, outcome
+                   [+ diameter, levels, wall_s]
+
+A run log with no ``run_end`` means the process died — crash attribution
+for free.  The schema is strict: unknown fields fail validation, so any
+addition requires a version bump (versioning policy in README.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import queue
+import subprocess
+import threading
+import time
+
+SCHEMA_VERSION = 1
+
+# Environment knobs (set by check.py --events/--phase-timers; inherited by
+# liveness re-runs and bench children the same way RAFT_TLA_SIGPRUNE is).
+ENV_EVENTS = "RAFT_TLA_EVENTS"
+
+_DEADLOCK_NAME = "Deadlock"  # engine.DEADLOCK's invariant name (avoid import)
+
+
+# --------------------------------------------------------------------------
+# schema validation
+
+
+def _is(value, spec) -> bool:
+    """Type check where bool is NOT an int (JSON booleans are not counts)."""
+    if spec is int:
+        return type(value) is int
+    if spec is _NUM:
+        return type(value) in (int, float)
+    if isinstance(spec, tuple):
+        return any(_is(value, s) for s in spec)
+    return isinstance(value, spec)
+
+
+class _NUM:  # sentinel: int or float, not bool
+    pass
+
+
+_BASE = {"v": int, "event": str, "ts": _NUM}
+
+_SEGMENT_REQUIRED = {
+    "wall_s": _NUM,
+    "n_states": int,
+    "level": int,
+    "n_transitions": int,
+    "dedup_hit_rate": _NUM,
+    "states_per_sec": _NUM,
+    "inc_states_per_sec": _NUM,
+    "since_resume": bool,
+}
+
+_REQUIRED = {
+    "run_start": {"engine": str, "universe": dict, "spec": str,
+                  "invariants": list, "resumed": bool},
+    "segment": _SEGMENT_REQUIRED,
+    "level_end": {"level": int, "n_states": int},
+    "checkpoint": {"path": str},
+    "violation": {"invariant": str},
+    "stop_requested": {"reason": str},
+    "run_end": {"n_states": int, "n_transitions": int, "complete": bool,
+                "outcome": str},
+}
+
+_OPTIONAL = {
+    "run_start": {"bounds": dict, "symmetry": list, "view": str,
+                  "chunk": int, "caps": str, "n_states": int,
+                  "n_devices": int, "git_sha": str, "fiducials": dict,
+                  "pid": int},
+    "segment": {"coverage": dict, "route_peak": int, "n_devices": int,
+                "inv_evals": dict, "phase_s": dict},
+    "level_end": {},
+    "checkpoint": {"n_states": int},
+    "violation": {"kind": str},
+    "stop_requested": {"source": str, "pid": int},
+    "run_end": {"diameter": int, "levels": list, "wall_s": _NUM},
+}
+
+
+def validate_event(d: dict) -> list:
+    """Return the list of schema violations in ``d`` ([] = valid).
+
+    Strict by design: unknown event types and unknown fields are errors,
+    so schema drift between engines is caught by the conformance test
+    instead of accumulating silently (the pre-obs failure mode).
+    """
+    errs = []
+    if not isinstance(d, dict):
+        return [f"not an object: {type(d).__name__}"]
+    for k, spec in _BASE.items():
+        if k not in d:
+            errs.append(f"missing base field {k!r}")
+        elif not _is(d[k], spec):
+            errs.append(f"base field {k!r} has wrong type")
+    if errs:
+        return errs
+    if d["v"] != SCHEMA_VERSION:
+        errs.append(f"schema version {d['v']} != {SCHEMA_VERSION}")
+    ev = d["event"]
+    if ev not in _REQUIRED:
+        return errs + [f"unknown event type {ev!r}"]
+    req, opt = _REQUIRED[ev], _OPTIONAL[ev]
+    for k, spec in req.items():
+        if k not in d:
+            errs.append(f"{ev}: missing required field {k!r}")
+        elif not _is(d[k], spec):
+            errs.append(f"{ev}: field {k!r} has wrong type")
+    for k, val in d.items():
+        if k in _BASE or k in req:
+            continue
+        if k not in opt:
+            errs.append(f"{ev}: unknown field {k!r} (schema is strict; "
+                        "additions need a version bump)")
+        elif not _is(val, opt[k]):
+            errs.append(f"{ev}: field {k!r} has wrong type")
+    return errs
+
+
+# --------------------------------------------------------------------------
+# progress schema
+
+
+@dataclasses.dataclass
+class ProgressRecord:
+    """The shared ``segment`` payload — what every engine's ``on_progress``
+    callback now receives (as a plain dict, via :meth:`to_dict`).
+
+    ``inc_states_per_sec`` is the primary rate: states discovered since
+    the previous record over wall time since the previous record.  It is
+    immune to the resume-inflation wart (ddd campaigns resume with the
+    prior process's ``n_states`` but a fresh wall clock).  The cumulative
+    ``states_per_sec`` is kept for quick glances and tagged by
+    ``since_resume``: True means the counters were accumulated entirely
+    by this process and the cumulative rate is honest; False means they
+    span prior processes and only the incremental rate is trustworthy.
+    """
+
+    wall_s: float
+    n_states: int
+    level: int
+    n_transitions: int
+    dedup_hit_rate: float
+    states_per_sec: float
+    inc_states_per_sec: float
+    since_resume: bool
+    coverage: dict | None = None      # per-action discovery counts
+    route_peak: int | None = None     # ddd: peak per-bucket route occupancy
+    n_devices: int | None = None      # shard engines: mesh size
+    inv_evals: dict | None = None     # per-invariant evaluation counts
+    phase_s: dict | None = None       # per-phase wall since last record
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None}
+
+
+class ProgressTracker:
+    """Rate arithmetic shared by every engine (formerly five copies).
+
+    ``n0`` is the state count already present when this process started:
+    1 for a fresh run, the checkpoint's count for a ddd resume, or None
+    when the baseline is unknown until the first device fetch (table
+    engines resuming a donated carry) — the first record then just
+    anchors and reports a zero incremental rate rather than a fabricated
+    one.
+
+    ``record(n_incl=...)`` takes the *inclusive* count (states + pending
+    keys awaiting host dedup) the ddd engines report; the anchor is
+    ``max`` -monotone across checkpoint-rollback resumes so incremental
+    rates never go negative — the logic that used to live in
+    ddd_engine's ``prev`` dict.
+    """
+
+    def __init__(self, t0: float, n0: int | None = 1,
+                 invariants: tuple = (), resumed: bool = False,
+                 n_devices: int | None = None):
+        self.t0 = t0
+        self._prev_wall = 0.0
+        self._prev_n = n0
+        self.invariants = tuple(invariants)
+        self.since_resume = not resumed
+        self.n_devices = n_devices
+
+    def anchor(self, n_states: int) -> None:
+        """Set the incremental-rate baseline (a resume's restored count),
+        so the first post-resume record's rate covers only new states."""
+        self._prev_n = max(self._prev_n or 0, int(n_states))
+
+    def record(self, n_states: int, level: int, n_transitions: int,
+               coverage: dict | None = None, route_peak: int | None = None,
+               n_incl: int | None = None,
+               phase_s: dict | None = None) -> ProgressRecord:
+        wall = time.monotonic() - self.t0
+        reported = n_states if n_incl is None else max(n_states, n_incl)
+        if self._prev_n is None:  # unknown baseline: anchor, rate 0
+            self._prev_n = reported
+        dn = max(0, reported - self._prev_n)
+        dt = wall - self._prev_wall
+        inc = dn / dt if dt > 0 else 0.0
+        self._prev_wall = wall
+        self._prev_n = max(self._prev_n, reported)
+        # Dedup hit rate uses the *exact* count: candidates generated vs
+        # distinct states actually admitted.
+        hit = 1.0 - n_states / max(1, n_transitions)
+        inv_evals = ({nm: n_transitions for nm in self.invariants}
+                     if self.invariants else None)
+        return ProgressRecord(
+            wall_s=round(wall, 3),
+            n_states=reported,
+            level=level,
+            n_transitions=n_transitions,
+            dedup_hit_rate=round(hit, 4),
+            states_per_sec=round(reported / max(wall, 1e-9), 1),
+            inc_states_per_sec=round(inc, 1),
+            since_resume=self.since_resume,
+            coverage=coverage,
+            route_peak=route_peak,
+            n_devices=self.n_devices,
+            inv_evals=inv_evals,
+            phase_s=phase_s or None,
+        )
+
+
+# --------------------------------------------------------------------------
+# JSONL writer
+
+
+_CLOSE = object()  # writer-thread sentinel
+
+
+class EventLog:
+    """Append-only JSONL event sink with a background writer thread.
+
+    ``emit`` serialises on the caller (cheap: small dicts) and enqueues;
+    file I/O happens on the daemon thread so a slow disk never stalls a
+    segment boundary.  ``close`` drains the queue and joins.  The file is
+    opened in append mode line-at-a-time-ish, so external one-shot
+    emitters (``python -m raft_tla_tpu.obs emit`` from campaign_stop.sh)
+    can interleave whole lines with a live run.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._thread = threading.Thread(
+            target=self._writer, name="obs-eventlog", daemon=True)
+        self._closed = False
+        self._thread.start()
+
+    def emit(self, event: str, **fields) -> dict:
+        d = {"v": SCHEMA_VERSION, "event": event,
+             "ts": round(time.time(), 3), **fields}
+        if not self._closed:
+            self._q.put(json.dumps(d, sort_keys=False) + "\n")
+        return d
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(_CLOSE)
+        self._thread.join(timeout=10.0)
+
+    def _writer(self) -> None:
+        with open(self.path, "a") as fh:
+            while True:
+                item = self._q.get()
+                if item is _CLOSE:
+                    break
+                lines = [item]
+                try:  # batch whatever queued up behind it
+                    while True:
+                        nxt = self._q.get_nowait()
+                        if nxt is _CLOSE:
+                            fh.writelines(lines)
+                            return
+                        lines.append(nxt)
+                except queue.Empty:
+                    pass
+                fh.writelines(lines)
+                fh.flush()
+
+
+def append_event(log_path: str, event: str, **fields) -> dict:
+    """Synchronously validate + append one event (external emitters:
+    bench.py's fiducial ``run_start``, the ``obs emit`` CLI).
+
+    First parameter named ``log_path`` so ``checkpoint`` events can pass
+    their ``path`` field as a keyword.
+    """
+    d = {"v": SCHEMA_VERSION, "event": event,
+         "ts": round(time.time(), 3), **fields}
+    errs = validate_event(d)
+    if errs:
+        raise ValueError(f"invalid {event!r} event: " + "; ".join(errs))
+    with open(log_path, "a") as fh:
+        fh.write(json.dumps(d) + "\n")
+    return d
+
+
+_GIT_SHA_CACHE: list = []
+
+
+def git_sha() -> str | None:
+    """Short commit sha of the checkout (best-effort, cached)."""
+    if not _GIT_SHA_CACHE:
+        try:
+            out = subprocess.run(
+                ["git", "rev-parse", "--short=12", "HEAD"],
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))),
+                capture_output=True, text=True, timeout=5)
+            sha = out.stdout.strip() if out.returncode == 0 else ""
+            _GIT_SHA_CACHE.append(sha or None)
+        except Exception:
+            _GIT_SHA_CACHE.append(None)
+    return _GIT_SHA_CACHE[0]
+
+
+# --------------------------------------------------------------------------
+# engine facade
+
+
+class RunTelemetry:
+    """What an engine's check loop drives instead of hand-rolled dicts.
+
+    Resolution: an explicit ``events`` path wins, else ``RAFT_TLA_EVENTS``
+    (the check.py / bench.py wiring), else no log — and with neither a log
+    nor an ``on_progress`` callback, :attr:`active` is False so engines
+    skip the per-segment device fetches entirely (the pre-obs fast path).
+
+    ``segment`` emits the shared record to both sinks and derives
+    ``level_end`` events from observed level transitions; ``run_end``
+    derives the ``violation`` event from the result.  ``close`` is
+    idempotent and safe under exceptions — a log ending without
+    ``run_end`` is the crash signature the monitor reports.
+    """
+
+    def __init__(self, engine: str, config=None, caps=None,
+                 on_progress=None, events: str | None = None,
+                 resumed: bool = False, n0: int | None = 1,
+                 n_devices: int | None = None, t0: float | None = None):
+        from raft_tla_tpu.obs.phases import PhaseTimers
+        self.engine = engine
+        self.config = config
+        self.caps = caps
+        self.on_progress = on_progress
+        self.resumed = resumed
+        path = events or os.environ.get(ENV_EVENTS) or None
+        self.log = EventLog(path) if path else None
+        self.phases = PhaseTimers.from_env()
+        inv = tuple(config.invariants) if config is not None else ()
+        self.tracker = ProgressTracker(
+            t0 if t0 is not None else time.monotonic(),
+            n0=n0, invariants=inv, resumed=resumed, n_devices=n_devices)
+        self._n_devices = n_devices
+        self._last_level: int | None = None
+        self._ended = False
+
+    @property
+    def active(self) -> bool:
+        """True when someone is listening (else skip the stats fetches)."""
+        return self.on_progress is not None or self.log is not None
+
+    # -- lifecycle events ---------------------------------------------------
+
+    def run_start(self, n_states: int | None = None,
+                  fiducials: dict | None = None) -> None:
+        if n_states is not None:
+            self.tracker.anchor(n_states)
+        if self.log is None:
+            return
+        cfg = self.config
+        fields: dict = {"engine": self.engine, "resumed": self.resumed}
+        if cfg is not None:
+            b = cfg.bounds
+            fields["universe"] = {"servers": b.n_servers, "values": b.n_values}
+            fields["bounds"] = {
+                "max_term": b.max_term, "max_log": b.max_log,
+                "max_msgs": b.max_msgs, "max_dup": b.max_dup,
+                "history": b.history}
+            fields["spec"] = cfg.spec
+            fields["invariants"] = list(cfg.invariants)
+            if cfg.symmetry:
+                fields["symmetry"] = list(cfg.symmetry)
+            if cfg.view is not None:
+                fields["view"] = cfg.view
+            fields["chunk"] = cfg.chunk
+        else:
+            fields["universe"] = {}
+            fields["spec"] = ""
+            fields["invariants"] = []
+        if self.caps is not None:
+            fields["caps"] = repr(self.caps)
+        if n_states is not None:
+            fields["n_states"] = int(n_states)
+        if self._n_devices is not None:
+            fields["n_devices"] = self._n_devices
+        sha = git_sha()
+        if sha:
+            fields["git_sha"] = sha
+        if fiducials:
+            fields["fiducials"] = fiducials
+        fields["pid"] = os.getpid()
+        self.log.emit("run_start", **fields)
+
+    def segment(self, n_states: int, level: int, n_transitions: int,
+                coverage: dict | None = None, route_peak: int | None = None,
+                n_incl: int | None = None) -> ProgressRecord:
+        rec = self.tracker.record(
+            n_states, level, n_transitions, coverage=coverage,
+            route_peak=route_peak, n_incl=n_incl,
+            phase_s=self.phases.snapshot())
+        if self.log is not None:
+            if self._last_level is not None and level > self._last_level:
+                # The boundary count is the count as observed at the first
+                # segment of the new level (exact for engines that call
+                # segment() at each boundary, best-known otherwise).
+                self.log.emit("level_end", level=level - 1,
+                              n_states=rec.n_states)
+            self.log.emit("segment", **rec.to_dict())
+        self._last_level = level
+        if self.on_progress is not None:
+            self.on_progress(rec.to_dict())
+        return rec
+
+    def checkpoint(self, path: str, n_states: int | None = None) -> None:
+        if self.log is None:
+            return
+        extra = {} if n_states is None else {"n_states": int(n_states)}
+        self.log.emit("checkpoint", path=str(path), **extra)
+
+    def stop_requested(self, reason: str, source: str = "engine") -> None:
+        if self.log is None:
+            return
+        self.log.emit("stop_requested", reason=reason, source=source,
+                      pid=os.getpid())
+
+    def violation(self, invariant: str, kind: str = "invariant") -> None:
+        if self.log is None:
+            return
+        self.log.emit("violation", invariant=invariant, kind=kind)
+
+    def run_end(self, result) -> None:
+        if self.log is None or self._ended:
+            return
+        self._ended = True
+        outcome = "ok" if result.complete else "stopped"
+        if result.violation is not None:
+            inv = result.violation.invariant
+            kind = "deadlock" if inv == _DEADLOCK_NAME else "invariant"
+            self.violation(inv, kind=kind)
+            outcome = "violation"
+        self.log.emit(
+            "run_end", n_states=int(result.n_states),
+            n_transitions=int(result.n_transitions),
+            complete=bool(result.complete), outcome=outcome,
+            diameter=int(result.diameter), levels=list(result.levels),
+            wall_s=round(float(result.wall_s), 3))
+
+    def close(self) -> None:
+        if self.log is not None:
+            self.log.close()

@@ -1,0 +1,311 @@
+"""Campaign monitor — read a run-event log and render a one-line
+heartbeat (``raft-tla-monitor``).
+
+The reader is the ONE place that knows how to turn an on-disk stream
+into a clean timeline; ``runs/campaign_projection.py`` is a thin client
+of :func:`load_stream` instead of carrying its own parsing.  Two stream
+dialects are accepted:
+
+- v1 event logs (obs/events.py): JSONL with ``event`` fields.
+- legacy ``runs/*.stats`` streams (bare ``on_progress`` dicts, one JSON
+  object per line, pre-obs campaigns): lifted to synthetic ``segment``
+  events so recorded artifacts like ``elect5ddd_r4_final.stats`` keep
+  working.  (The third historical dialect, the space-separated
+  ``.telemetry`` columns, is retired — see README.)
+
+Timeline normalisation (formerly campaign_projection.load):
+
+- **wall rebasing** — each process restart resets ``wall_s`` to ~0; a
+  drop in ``wall_s`` advances a cumulative offset so ``cum_wall_s`` is a
+  single monotone clock across every resume in the file.
+- **rollback dropping** — a checkpoint-rollback resume replays counts
+  the surviving timeline already passed (r4 has one at L30); segments
+  whose reported count sits below the running maximum are dropped from
+  the ``segments`` timeline (kept in ``events``).
+
+The heartbeat shows: level, states, incremental rate (trailing window),
+ETA (to ``--target``, else to end-of-level from the frontier trend),
+phase breakdown (when ``--phase-timers`` ran), fiducial drift vs the
+first ``run_start``, and the end-state attribution (``run_end`` outcome,
+``stop_requested`` reason, or "no run_end" = live or crashed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from raft_tla_tpu.obs.events import validate_event
+
+
+# --------------------------------------------------------------------------
+# stream reading
+
+
+def load_stream(path: str, drop_rollbacks: bool = True) -> dict:
+    """Parse an event log (or legacy .stats stream) into a clean timeline.
+
+    Returns ``{"events", "segments", "invalid", "legacy"}``: all valid
+    events in file order; the normalised segment timeline (each dict
+    gains ``cum_wall_s``, the resume-rebased cumulative clock); a list of
+    ``(lineno, errors)`` for lines that failed validation; and whether
+    any legacy (bare-dict) lines were lifted.
+    """
+    events: list = []
+    invalid: list = []
+    legacy = False
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except ValueError as e:
+                invalid.append((lineno, [f"not JSON: {e}"]))
+                continue
+            if isinstance(d, dict) and "event" not in d:
+                # legacy stats line: lift to a synthetic segment event
+                if "n_states" in d and "wall_s" in d:
+                    legacy = True
+                    d = {"v": 0, "event": "segment", "ts": None, **d}
+                else:
+                    invalid.append((lineno, ["unrecognised legacy line"]))
+                    continue
+            else:
+                errs = validate_event(d)
+                if errs:
+                    invalid.append((lineno, errs))
+                    continue
+            events.append(d)
+
+    # wall rebasing: one cumulative clock across in-file resumes
+    offset = prev = 0.0
+    segments = []
+    for e in events:
+        if e["event"] != "segment":
+            continue
+        w = float(e["wall_s"])
+        if w < prev:
+            offset += prev
+        prev = w
+        seg = dict(e, cum_wall_s=w + offset)
+        segments.append(seg)
+
+    if drop_rollbacks:
+        n_max, kept = -1, []
+        for s in segments:
+            if s["n_states"] >= n_max:
+                kept.append(s)
+                n_max = s["n_states"]
+        segments = kept
+
+    return {"events": events, "segments": segments,
+            "invalid": invalid, "legacy": legacy}
+
+
+# --------------------------------------------------------------------------
+# summarising
+
+
+def _trailing_rate(segments: list, window_s: float) -> float:
+    """Incremental rate over the trailing window of the timeline."""
+    if not segments:
+        return 0.0
+    w = segments[-1]["cum_wall_s"]
+    tail = [s for s in segments if s["cum_wall_s"] >= w - window_s]
+    if len(tail) >= 2:
+        dt = tail[-1]["cum_wall_s"] - tail[0]["cum_wall_s"]
+        if dt > 0:
+            return (tail[-1]["n_states"] - tail[0]["n_states"]) / dt
+    return float(segments[-1].get("inc_states_per_sec", 0.0))
+
+
+def _level_sizes(events: list, segments: list) -> dict:
+    """Per-level state-count increments from level boundaries.
+
+    v1 logs carry explicit ``level_end`` events; legacy streams only
+    have the level column, so boundaries are inferred from the first
+    segment of each level.
+    """
+    boundary = {}  # level -> cumulative count at its end
+    for e in events:
+        if e["event"] == "level_end":
+            boundary[e["level"]] = e["n_states"]
+    if not boundary:
+        seen_level = None
+        for s in segments:
+            if seen_level is not None and s["level"] > seen_level:
+                boundary[s["level"] - 1] = s["n_states"]
+            seen_level = s["level"]
+    sizes = {}
+    ks = sorted(boundary)
+    for i, k in enumerate(ks):
+        lo = boundary[ks[i - 1]] if i else 0
+        sizes[k] = boundary[k] - lo
+    return sizes
+
+
+def _eta_s(summary: dict) -> float | None:
+    """Seconds to the target count, else to end-of-level projected from
+    the frontier trend (ratio of the last two completed level sizes)."""
+    inc = summary["inc_states_per_sec"]
+    if inc <= 0:
+        return None
+    if summary.get("target"):
+        return max(0.0, summary["target"] - summary["n_states"]) / inc
+    sizes = summary["level_sizes"]
+    ks = sorted(sizes)
+    if len(ks) < 2 or sizes[ks[-2]] <= 0:
+        return None
+    ratio = sizes[ks[-1]] / sizes[ks[-2]]
+    projected = sizes[ks[-1]] * ratio        # expected size of current level
+    boundary_n = sum(sizes[k] for k in ks)   # count at last boundary
+    done_in_level = summary["n_states"] - boundary_n
+    return max(0.0, projected - done_in_level) / inc
+
+
+def summarize(stream: dict, window_s: float = 600.0,
+              target: int | None = None) -> dict | None:
+    """Distil a loaded stream into the heartbeat fields (None = no data)."""
+    segments = stream["segments"]
+    events = stream["events"]
+    if not segments:
+        return None
+    cur = segments[-1]
+    summary = {
+        "level": cur["level"],
+        "n_states": cur["n_states"],
+        "cum_wall_s": cur["cum_wall_s"],
+        "inc_states_per_sec": _trailing_rate(segments, window_s),
+        "since_resume": cur.get("since_resume"),
+        "route_peak": cur.get("route_peak"),
+        "level_sizes": _level_sizes(events, segments),
+        "target": target,
+        "legacy": stream["legacy"],
+        "n_invalid": len(stream["invalid"]),
+    }
+    summary["eta_s"] = _eta_s(summary)
+
+    # phase breakdown: aggregate phase_s across the trailing window
+    acc: dict = {}
+    w = cur["cum_wall_s"]
+    for s in segments:
+        if s["cum_wall_s"] >= w - window_s:
+            for k, v in (s.get("phase_s") or {}).items():
+                acc[k] = acc.get(k, 0.0) + v
+    total = sum(acc.values())
+    summary["phase_pct"] = (
+        {k: 100.0 * v / total for k, v in acc.items()} if total > 0 else {})
+
+    # fiducial drift: latest run_start's fiducials vs the first's
+    fids = [e["fiducials"] for e in events
+            if e["event"] == "run_start" and e.get("fiducials")]
+    drift = {}
+    if len(fids) >= 1:
+        first, last = fids[0], fids[-1]
+        for key in ("synthetic_step_ms", "copy_512mb_ms"):
+            if first.get(key) and last.get(key):
+                drift[key] = last[key] / first[key]
+    summary["fiducial_drift"] = drift
+
+    # end-state attribution
+    status = "live?"  # no run_end yet: still running, or crashed
+    for e in events:
+        if e["event"] == "stop_requested":
+            status = f"stop requested ({e['reason']})"
+    for e in events:
+        if e["event"] == "violation":
+            status = f"VIOLATION {e['invariant']}"
+    for e in events:
+        if e["event"] == "run_end":
+            status = e["outcome"]
+    summary["status"] = status
+    return summary
+
+
+def _fmt_eta(s: float) -> str:
+    if s < 90:
+        return f"{s:.0f}s"
+    if s < 5400:
+        return f"{s / 60:.0f}m"
+    return f"{s / 3600:.1f}h"
+
+
+def heartbeat(summary: dict | None) -> str:
+    """Render the one-line heartbeat."""
+    if summary is None:
+        return "obs: no segments yet"
+    parts = [
+        f"L{summary['level']}",
+        f"{summary['n_states']:,} st",
+        f"inc {summary['inc_states_per_sec']:,.0f}/s",
+        f"wall {summary['cum_wall_s']:,.0f}s",
+    ]
+    if summary["eta_s"] is not None:
+        tag = "target" if summary.get("target") else "level"
+        parts.append(f"ETA {tag} ~{_fmt_eta(summary['eta_s'])}")
+    if summary["phase_pct"]:
+        parts.append(" ".join(
+            f"{k} {v:.0f}%" for k, v in
+            sorted(summary["phase_pct"].items(), key=lambda kv: -kv[1])))
+    for key, short in (("synthetic_step_ms", "step"),
+                       ("copy_512mb_ms", "copy")):
+        if key in summary["fiducial_drift"]:
+            parts.append(f"{short} drift {summary['fiducial_drift'][key]:.2f}x")
+    if summary.get("route_peak") is not None:
+        parts.append(f"route_peak {summary['route_peak']}")
+    parts.append(summary["status"])
+    line = " | ".join(parts)
+    if summary["n_invalid"]:
+        line += f"  [{summary['n_invalid']} invalid lines]"
+    return line
+
+
+# --------------------------------------------------------------------------
+# CLI
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="raft-tla-monitor",
+        description="One-line heartbeat over a run-event log "
+                    "(or legacy .stats stream).")
+    p.add_argument("path", help="event log (JSONL) to read")
+    p.add_argument("--follow", action="store_true",
+                   help="re-read and re-print every --interval seconds")
+    p.add_argument("--interval", type=float, default=10.0)
+    p.add_argument("--window", type=float, default=600.0,
+                   help="trailing window for the incremental rate (s)")
+    p.add_argument("--target", type=int, default=None,
+                   help="ETA to this state count instead of end-of-level")
+    p.add_argument("--json", action="store_true",
+                   help="print the full summary as JSON instead")
+    args = p.parse_args(argv)
+
+    while True:
+        try:
+            stream = load_stream(args.path)
+        except FileNotFoundError:
+            print(f"obs: waiting for {args.path}", flush=True)
+            stream = None
+        if stream is not None:
+            summary = summarize(stream, window_s=args.window,
+                                target=args.target)
+            if args.json:
+                print(json.dumps(summary, default=str), flush=True)
+            else:
+                print(heartbeat(summary), flush=True)
+        if not args.follow:
+            return 0 if stream is not None else 1
+        time.sleep(args.interval)
+
+
+def entry() -> None:
+    sys.exit(main())
+
+
+if __name__ == "__main__":
+    entry()

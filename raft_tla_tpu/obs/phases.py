@@ -1,0 +1,110 @@
+"""Device-sync-aware phase timers — the measurement layer for the
+export-anatomy / post-filter-anatomy chip jobs (ROADMAP item 3).
+
+Timing an async-dispatch JAX program phase-by-phase requires a
+``block_until_ready`` at each phase edge, which *serialises* the very
+pipelining the engines rely on (the ddd engines dispatch segment k+1
+before harvesting segment k).  So the timers are **off by default** and
+the off path is engineered to be unmeasurable:
+
+- ``phase(name)`` returns one shared, stateless no-op handle when
+  disabled — no allocation, no clock read, no sync, nothing for jit to
+  see.  An A/B with chip-state fiducials backs this (RESULTS.md).
+- enabled (``--phase-timers`` / ``RAFT_TLA_PHASE_TIMERS=1``), each
+  ``with timers.phase("expand") as ph: ... ph.sync(out)`` blocks on the
+  value handed to ``sync`` before stamping, so the phase wall is honest
+  device time, not dispatch time.  Enabling timers trades pipelining for
+  attribution — per-phase numbers are for anatomy runs, not records.
+
+Accumulated walls are drained into each ``segment`` event's ``phase_s``
+field by :meth:`PhaseTimers.snapshot`.
+
+Phase vocabulary (shared so logs compare across engines): ``upload``
+(host->device frontier/block staging), ``expand`` (the jit segment),
+``export`` (device->host harvest / pageout), ``dedup`` (host-side exact
+dedup flush, ddd only), ``snapshot`` (checkpoint save).
+
+This module is host-path orchestration only — nothing here is ever
+traced (the no-op handle is what jit-adjacent code touches).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+ENV_PHASE_TIMERS = "RAFT_TLA_PHASE_TIMERS"
+
+
+class _NullPhase:
+    """The disabled-path handle: a shared singleton that does nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def sync(self, value=None):
+        return value
+
+
+_NULL = _NullPhase()
+
+
+class _Phase:
+    """An enabled timed region; ``sync(x)`` marks x to block on at exit."""
+
+    __slots__ = ("_timers", "_name", "_t0", "_pending")
+
+    def __init__(self, timers: "PhaseTimers", name: str):
+        self._timers = timers
+        self._name = name
+        self._pending = None
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def sync(self, value=None):
+        self._pending = value
+        return value
+
+    def __exit__(self, *exc):
+        if self._pending is not None:
+            import jax  # host path; deferred so obs imports stay light
+            jax.block_until_ready(self._pending)
+            self._pending = None
+        acc = self._timers._acc
+        acc[self._name] = acc.get(self._name, 0.0) + (
+            time.monotonic() - self._t0)
+        return False
+
+
+class PhaseTimers:
+    """Per-phase wall-time accumulator; disabled unless asked for."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._acc: dict = {}
+
+    @classmethod
+    def from_env(cls) -> "PhaseTimers":
+        return cls(os.environ.get(ENV_PHASE_TIMERS, "").lower()
+                   in ("1", "on", "true", "yes"))
+
+    def phase(self, name: str):
+        if not self.enabled:
+            return _NULL
+        return _Phase(self, name)
+
+    def snapshot(self, reset: bool = True) -> dict:
+        """Drain accumulated per-phase walls (rounded; {} when disabled)."""
+        if not self._acc:
+            return {}
+        out = {k: round(v, 4) for k, v in sorted(self._acc.items())}
+        if reset:
+            self._acc = {}
+        return out

@@ -40,10 +40,12 @@ import numpy as np
 
 from raft_tla_tpu.config import CheckConfig
 from raft_tla_tpu.device_engine import (
-    _EMPTY, _dedup_insert, _progress_stats, BUCKET, Carry, FAIL_INDEX,
+    _EMPTY, _dedup_insert, BUCKET, Carry, FAIL_INDEX,
     FAIL_LEVEL, FAIL_PROBE, FAIL_RING, FAIL_WIDTH, decode_fail, _carry_done,
-    _acc64_add, _acc64_zero, acc64_int, widen_legacy_n_trans)
+    _acc64_add, _acc64_zero, acc64_int, aggregate_coverage,
+    widen_legacy_n_trans)
 from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
+from raft_tla_tpu.obs import RunTelemetry
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import bitpack
 from raft_tla_tpu.ops import fingerprint as fpr
@@ -346,16 +348,31 @@ class PagedEngine:
               on_progress=None, checkpoint: str | None = None,
               checkpoint_every_s: float = 300.0,
               resume: str | None = None,
-              deadline_s: float | None = None) -> EngineResult:
-        """``on_progress`` as in DeviceEngine.check: structured per-segment
-        run stats (SURVEY §5).  ``checkpoint``/``resume`` as in
-        DeviceEngine, additionally snapshotting the host store.
+              deadline_s: float | None = None,
+              events: str | None = None) -> EngineResult:
+        """``on_progress``/``events`` as in DeviceEngine.check: the shared
+        per-segment ProgressRecord + run-event log (SURVEY §5).
+        ``checkpoint``/``resume`` as in DeviceEngine, additionally
+        snapshotting the host store.
 
         ``deadline_s`` time-boxes the search: segments stop once that many
         seconds have passed AFTER the first (compile-carrying) segment, and
         the result comes back with ``complete=False`` and the counts found
         so far — the bench's north-star-shaped throughput probe."""
         t0 = time.monotonic()
+        tel = RunTelemetry(
+            "paged", config=self.config, caps=self.caps,
+            on_progress=on_progress, events=events,
+            resumed=resume is not None,
+            n0=1 if resume is None else None, t0=t0)
+        try:
+            return self._check_impl(tel, t0, init_override, checkpoint,
+                                    checkpoint_every_s, resume, deadline_s)
+        finally:
+            tel.close()
+
+    def _check_impl(self, tel, t0, init_override, checkpoint,
+                    checkpoint_every_s, resume, deadline_s) -> EngineResult:
         bounds = self.bounds
         init_py = init_override if init_override is not None \
             else interp.init_state(bounds)
@@ -363,13 +380,16 @@ class PagedEngine:
         hi0, lo0 = sym_mod.init_fingerprint(self.config, init_py,
                                             init_vec)
 
+        tel.run_start()
         for nm in self.config.invariants:
             if not inv_mod.py_invariant(nm)(init_py, bounds):
-                return EngineResult(
+                res = EngineResult(
                     n_states=1, diameter=0, n_transitions=0,
                     coverage=Counter(),
                     violation=Violation(nm, init_py, [(None, init_py)]),
                     levels=[1], wall_s=time.monotonic() - t0)
+                tel.run_end(res)
+                return res
 
         if resume:
             carry, host, paged = self.load_checkpoint(resume, (hi0, lo0))
@@ -392,17 +412,25 @@ class PagedEngine:
             if (deadline_s is not None and t_warm is not None
                     and time.monotonic() - t_warm > deadline_s):
                 complete = False
+                tel.stop_requested("deadline")
                 break
             # Pause the device loop before unpaged rows could be overwritten:
             # rows < pause_at are safe while n_states - lvl_start <= ring.
             pause_at = paged + self.caps.ring // 2
             t_seg = time.monotonic()
-            carry, done, steps_d = self._segment(carry, jnp.int32(budget),
-                                                 jnp.int32(pause_at))
-            n_states = int(carry.n_states)
-            paged = self._pageout(carry, host, paged, n_states)
-            if on_progress is not None:
-                on_progress(_progress_stats(carry, t0, self.table))
+            with tel.phases.phase("expand") as ph:
+                carry, done, steps_d = self._segment(carry, jnp.int32(budget),
+                                                     jnp.int32(pause_at))
+                n_states = int(carry.n_states)
+            with tel.phases.phase("export"):
+                paged = self._pageout(carry, host, paged, n_states)
+            if tel.active:
+                lvl, n_trans, cov = jax.device_get(
+                    (carry.lvl, carry.n_trans, carry.cov))
+                tel.segment(
+                    n_states=n_states, level=int(lvl),
+                    n_transitions=acc64_int(n_trans),
+                    coverage=dict(aggregate_coverage(self.table, cov)))
             if bool(done):
                 break
             dt = time.monotonic() - t_seg
@@ -411,8 +439,10 @@ class PagedEngine:
             executed = max(1, int(steps_d))
             if checkpoint and (time.monotonic() - last_ckpt
                                >= checkpoint_every_s):
-                self.save_checkpoint(checkpoint, carry, host, paged,
-                                     (hi0, lo0))
+                with tel.phases.phase("snapshot"):
+                    self.save_checkpoint(checkpoint, carry, host, paged,
+                                         (hi0, lo0))
+                tel.checkpoint(checkpoint, n_states)
                 last_ckpt = time.monotonic()
             if t_warm is None:
                 t_warm = time.monotonic()   # deadline starts post-compile
@@ -453,11 +483,13 @@ class PagedEngine:
                 state=chain[-1][1], trace=chain)
         host.close()
 
-        return EngineResult(
+        result = EngineResult(
             n_states=n_states, diameter=len(levels_arr) - 1,
             n_transitions=acc64_int(n_trans), coverage=coverage,
             violation=violation, levels=levels_arr,
             wall_s=time.monotonic() - t0, complete=complete)
+        tel.run_end(result)
+        return result
 
 
 def check(config: CheckConfig, caps: PagedCapacities | None = None,

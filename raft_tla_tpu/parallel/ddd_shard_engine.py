@@ -80,6 +80,7 @@ from raft_tla_tpu.ddd_engine import (
     load_frontier_snapshot, save_ddd_snapshot, save_frontier_snapshot)
 from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
+from raft_tla_tpu.obs import RunTelemetry
 from raft_tla_tpu.ops import bitpack
 from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
@@ -628,16 +629,24 @@ class DDDShardEngine:
     def check(self, init_override: interp.PyState | None = None,
               on_progress=None, checkpoint: str | None = None,
               checkpoint_every_s: float = 600.0,
-              resume: str | None = None) -> EngineResult:
+              resume: str | None = None,
+              events: str | None = None) -> EngineResult:
         import contextlib
         with contextlib.ExitStack() as stack:
             return self._check_impl(init_override, on_progress,
                                     checkpoint, checkpoint_every_s,
-                                    resume, stack)
+                                    resume, stack, events)
 
     def _check_impl(self, init_override, on_progress, checkpoint,
-                    checkpoint_every_s, resume, _cleanup) -> EngineResult:
+                    checkpoint_every_s, resume, _cleanup,
+                    events=None) -> EngineResult:
         t0 = time.monotonic()
+        tel = RunTelemetry(
+            "ddd-shard", config=self.config, caps=self.caps,
+            on_progress=on_progress, events=events,
+            resumed=resume is not None, n0=1,
+            n_devices=self.ndev, t0=t0)
+        _cleanup.callback(tel.close)
         bounds = self.bounds
         init_py = init_override if init_override is not None \
             else interp.init_state(bounds)
@@ -647,11 +656,13 @@ class DDDShardEngine:
         for nm in self.config.invariants:
             if not inv_mod.py_invariant(nm)(init_py, bounds):
                 from collections import Counter
-                return EngineResult(
+                res = EngineResult(
                     n_states=1, diameter=0, n_transitions=0,
                     coverage=Counter(),
                     violation=Violation(nm, init_py, [(None, init_py)]),
                     levels=[1], wall_s=time.monotonic() - t0)
+                tel.run_end(res)
+                return res
 
         frontier = self.caps.retention == "frontier"
         tmpdir = None
@@ -740,46 +751,35 @@ class DDDShardEngine:
                                     self.SEG_CLAMP_S)
         budget = pacer.budget
         last_ckpt = time.monotonic()
-
-        prev = {"wall": 0.0, "n": n_states}   # incremental-rate anchor
+        tel.run_start(n_states=n_states)
 
         def progress():
-            if on_progress is None:
+            if not tel.active:
                 return
-            wall = time.monotonic() - t0
-            # anchor the incremental rate on the same INCLUSIVE count
-            # the n_states field reports: bare n_states only advances
-            # at window-boundary drains, which would read as 0-0-spike
+            # report the same INCLUSIVE count the old stats stream did:
+            # bare n_states only advances at window-boundary drains,
+            # which would read as 0-0-spike.  Staged counts are exact
+            # (post-dedup); pend is the raw harvested stream, so the sum
+            # is an upper bound — same contract as the single-chip
+            # engine's progress().  The tracker's running-max anchor
+            # keeps the post-drain dip from reading as a negative rate.
             n_incl = n_states + sum(
                 sum(len(k) for k in st_["keys"]) for st_ in staging) \
                 + sum(sum(len(k) for k in p_["keys"]) for p_ in pend)
-            # rate anchors on the running max: pend is pre-dedup, so the
-            # inclusive count can dip after a drain — never report a
-            # negative rate
-            anchor = max(prev["n"], n_incl)
-            dn, dw = anchor - prev["n"], wall - prev["wall"]
-            prev.update(wall=wall, n=anchor)
-            on_progress({
-                "wall_s": round(wall, 3),
-                "n_states": n_incl,
-                # staged counts are exact (post-dedup); pend is the raw
-                # harvested stream, so the sum is an upper bound — same
-                # contract as the single-chip engine's progress()
-                "level": len(level_ends),
-                "n_transitions": n_trans,
-                "n_devices": self.ndev,
-                "states_per_sec": round(n_states / max(wall, 1e-9), 1),
-                "inc_states_per_sec": round(dn / max(dw, 1e-9), 1),
-                "coverage": dict(aggregate_coverage(self.table, cov)),
-            })
+            tel.segment(
+                n_states=n_states, n_incl=n_incl,
+                level=len(level_ends), n_transitions=n_trans,
+                coverage=dict(aggregate_coverage(self.table, cov)))
 
         while not stopped:
             lvl_lo = level_ends[-2] if len(level_ends) > 1 else 0
             lvl_hi = level_ends[-1]
             for wbase in range(lvl_lo + blocks_done * W, lvl_hi, W):
                 wrows = min(W, lvl_hi - wbase)
-                fbuf, fcon, fpar, nrows, n_chunks = self._upload_window(
-                    host, constore, wbase, wrows)
+                with tel.phases.phase("upload") as ph:
+                    fbuf, fcon, fpar, nrows, n_chunks = \
+                        self._upload_window(host, constore, wbase, wrows)
+                    ph.sync((fbuf, fcon, fpar))
                 fc = fc._replace(c=jnp.int32(0))
                 # Two-deep segment pipeline (the ddd_engine PP overlap):
                 # segment k+1 depends on k only through the filter carry,
@@ -799,19 +799,25 @@ class DDDShardEngine:
                     if not (window_done or stopped) and free:
                         idx = free.pop(0)
                         t_disp = time.monotonic()
-                        fc, bufsets[idx], stats = self._segment(
-                            fc, bufsets[idx], fbuf, fcon, fpar, nrows,
-                            jnp.int32(budget), jnp.int32(n_chunks))
+                        # NB: enabling phase timers blocks each dispatch,
+                        # trading the two-deep overlap for honest walls
+                        with tel.phases.phase("expand") as ph:
+                            fc, bufsets[idx], stats = self._segment(
+                                fc, bufsets[idx], fbuf, fcon, fpar,
+                                nrows, jnp.int32(budget),
+                                jnp.int32(n_chunks))
+                            ph.sync(stats)
                         q.append((idx, stats, t_disp))
                         if len(q) < 2:
                             continue         # keep the pipeline full
                     if not q:
                         break
                     idx, stats, t_disp = q.pop(0)
-                    st_h = jax.device_get(stats)
-                    cursors = np.asarray(st_h.cursor)
-                    bufs_h = jax.device_get(bufsets[idx]) \
-                        if cursors.sum() and not stopped else None
+                    with tel.phases.phase("export"):
+                        st_h = jax.device_get(stats)
+                        cursors = np.asarray(st_h.cursor)
+                        bufs_h = jax.device_get(bufsets[idx]) \
+                            if cursors.sum() and not stopped else None
                     free.append(idx)
                     if stopped:
                         continue             # drop post-stop segments
@@ -872,7 +878,9 @@ class DDDShardEngine:
                     for s in range(self.ndev):
                         if sum(len(x) for x in pend[s]["keys"]) >= \
                                 self.caps.flush:
-                            self._flush_shard(s, pend, masters, staging)
+                            with tel.phases.phase("dedup"):
+                                self._flush_shard(s, pend, masters,
+                                                  staging)
                             flushed = True
                     if flushed:
                         # the flush ran while the next segment computed;
@@ -883,10 +891,11 @@ class DDDShardEngine:
                 if stopped:
                     break
                 # window boundary: flush all shards, drain shard-major
-                for s in range(self.ndev):
-                    self._flush_shard(s, pend, masters, staging)
-                n_states += self._drain(staging, host, constore, keystore,
-                                        cov)
+                with tel.phases.phase("dedup"):
+                    for s in range(self.ndev):
+                        self._flush_shard(s, pend, masters, staging)
+                    n_states += self._drain(staging, host, constore,
+                                            keystore, cov)
                 blocks_done += 1
                 if n_states > _IDX_CEIL:
                     fail = FAIL_INDEX
@@ -894,10 +903,12 @@ class DDDShardEngine:
                     break
                 if checkpoint and (time.monotonic() - last_ckpt
                                    >= checkpoint_every_s):
-                    self.save_checkpoint(checkpoint, host, constore,
-                                         keystore, n_states, n_trans,
-                                         cov, level_ends, blocks_done,
-                                         (hi0, lo0))
+                    with tel.phases.phase("snapshot"):
+                        self.save_checkpoint(checkpoint, host, constore,
+                                             keystore, n_states, n_trans,
+                                             cov, level_ends, blocks_done,
+                                             (hi0, lo0))
+                    tel.checkpoint(checkpoint, n_states)
                     last_ckpt = time.monotonic()
             if stopped:
                 break
@@ -921,9 +932,11 @@ class DDDShardEngine:
 
         # terminal drain (stopped runs keep everything streamed so far —
         # the relaxed chunk-granular stop, as shard_engine)
-        for s in range(self.ndev):
-            self._flush_shard(s, pend, masters, staging)
-        n_states += self._drain(staging, host, constore, keystore, cov)
+        with tel.phases.phase("dedup"):
+            for s in range(self.ndev):
+                self._flush_shard(s, pend, masters, staging)
+            n_states += self._drain(staging, host, constore, keystore,
+                                    cov)
         if fail:
             raise RuntimeError(
                 f"DDD-shard search aborted: {decode_fail(fail)} "
@@ -992,11 +1005,13 @@ class DDDShardEngine:
         host.close()
         constore.close()
         keystore.close()
-        return EngineResult(
+        result = EngineResult(
             n_states=n_states, diameter=len(levels_arr) - 1,
             n_transitions=n_trans, coverage=coverage,
             violation=violation, levels=levels_arr,
             wall_s=time.monotonic() - t0)
+        tel.run_end(result)
+        return result
 
 
 def check(config: CheckConfig, mesh: Mesh | None = None,

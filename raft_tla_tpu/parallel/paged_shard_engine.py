@@ -53,8 +53,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tla_tpu.config import CheckConfig
 from raft_tla_tpu.device_engine import (
     _EMPTY, _dedup_insert, BUCKET, FAIL_INDEX, FAIL_LEVEL, FAIL_PROBE,
-    FAIL_RING, FAIL_WIDTH, decode_fail, _acc64_add, _acc64_zero, acc64_int)
+    FAIL_RING, FAIL_WIDTH, decode_fail, _acc64_add, _acc64_zero, acc64_int,
+    aggregate_coverage)
 from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
+from raft_tla_tpu.obs import RunTelemetry
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.ops import bitpack
 from raft_tla_tpu.ops import kernels
@@ -503,21 +505,38 @@ class PagedShardEngine:
               checkpoint: str | None = None,
               checkpoint_every_s: float = 600.0,
               resume: str | None = None,
-              on_progress=None) -> EngineResult:
+              on_progress=None, events: str | None = None) -> EngineResult:
         t0 = time.monotonic()
+        tel = RunTelemetry(
+            "pagedshard", config=self.config, caps=self.caps,
+            on_progress=on_progress, events=events,
+            resumed=resume is not None,
+            n0=1 if resume is None else None,
+            n_devices=self.ndev, t0=t0)
+        try:
+            return self._check_impl(tel, t0, init_override, checkpoint,
+                                    checkpoint_every_s, resume)
+        finally:
+            tel.close()
+
+    def _check_impl(self, tel, t0, init_override, checkpoint,
+                    checkpoint_every_s, resume) -> EngineResult:
         bounds = self.bounds
         init_py = init_override if init_override is not None \
             else interp.init_state(bounds)
         init_vec = interp.to_vec(init_py, bounds)
         hi0, lo0 = sym_mod.init_fingerprint(self.config, init_py, init_vec)
+        tel.run_start()
 
         for nm in self.config.invariants:
             if not inv_mod.py_invariant(nm)(init_py, bounds):
-                return EngineResult(
+                res = EngineResult(
                     n_states=1, diameter=0, n_transitions=0,
                     coverage=Counter(),
                     violation=Violation(nm, init_py, [(None, init_py)]),
                     levels=[1], wall_s=time.monotonic() - t0)
+                tel.run_end(res)
+                return res
 
         if resume:
             carry, hosts, paged = self.load_checkpoint(resume, (hi0, lo0))
@@ -539,19 +558,29 @@ class PagedShardEngine:
         while True:
             paged_d = jnp.asarray(np.asarray(paged, np.int32))
             t_seg = time.monotonic()
-            steps_d, carry = self._segment(carry, jnp.int32(budget),
-                                           paged_d)
-            paged = self._pageout(carry, hosts, paged)
-            if on_progress is not None:
-                on_progress(self._progress_stats(carry, t0))
+            with tel.phases.phase("expand") as ph:
+                steps_d, carry = self._segment(carry, jnp.int32(budget),
+                                               paged_d)
+                ph.sync(steps_d)
+            with tel.phases.phase("export"):
+                paged = self._pageout(carry, hosts, paged)
+            if tel.active:
+                n_states_d, lvl, n_trans_d, cov_arr = jax.device_get(
+                    (carry.n_states, carry.lvl, carry.n_trans, carry.cov))
+                tel.segment(
+                    n_states=int(np.asarray(n_states_d).sum()),
+                    level=int(lvl), n_transitions=acc64_int(n_trans_d),
+                    coverage=dict(aggregate_coverage(self.table, cov_arr)))
             if bool(np.asarray(carry.stop)):
                 break
             dt = time.monotonic() - t_seg
             executed = max(1, int(np.asarray(steps_d)))
             if checkpoint and (time.monotonic() - last_ckpt
                                >= checkpoint_every_s):
-                self.save_checkpoint(checkpoint, carry, hosts, paged,
-                                     (hi0, lo0))
+                with tel.phases.phase("snapshot"):
+                    self.save_checkpoint(checkpoint, carry, hosts, paged,
+                                         (hi0, lo0))
+                tel.checkpoint(checkpoint)
                 last_ckpt = time.monotonic()
             budget = pacer.update(dt, executed)
             self.seg_chunks = budget
@@ -586,7 +615,7 @@ class PagedShardEngine:
         for h in hosts:
             h.close()
 
-        return EngineResult(
+        result = EngineResult(
             n_states=n_states,
             diameter=len(levels_arr) - 1,
             n_transitions=acc64_int(n_trans_d),
@@ -594,23 +623,8 @@ class PagedShardEngine:
             violation=violation,
             levels=levels_arr,
             wall_s=time.monotonic() - t0)
-
-    def _progress_stats(self, carry: PSCarry, t0: float) -> dict:
-        n_states_d, lvl, n_trans_d = jax.device_get(
-            (carry.n_states, carry.lvl, carry.n_trans))
-        n_states = int(np.asarray(n_states_d).sum())
-        n_trans = acc64_int(n_trans_d)
-        wall = time.monotonic() - t0
-        return {
-            "wall_s": round(wall, 3),
-            "n_states": n_states,
-            "level": int(lvl),
-            "n_transitions": n_trans,
-            "n_devices": self.ndev,
-            "dedup_hit_rate": round(
-                max(0.0, 1.0 - n_states / max(n_trans, 1)), 4),
-            "states_per_sec": round(n_states / max(wall, 1e-9), 1),
-        }
+        tel.run_end(result)
+        return result
 
     def _extract_trace(self, hosts: list, dev: int, lidx: int,
                        viol_i: int) -> Violation:

@@ -54,6 +54,7 @@ from raft_tla_tpu.device_engine import (
     _acc64_zero, acc64_int)
 from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
+from raft_tla_tpu.obs import RunTelemetry
 from raft_tla_tpu.ops import bitpack
 from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
@@ -342,21 +343,38 @@ class StreamedEngine:
               on_progress=None, checkpoint: str | None = None,
               checkpoint_every_s: float = 600.0,
               resume: str | None = None,
-              deadline_s: float | None = None) -> EngineResult:
+              deadline_s: float | None = None,
+              events: str | None = None) -> EngineResult:
         t0 = time.monotonic()
+        tel = RunTelemetry(
+            "streamed", config=self.config, caps=self.caps,
+            on_progress=on_progress, events=events,
+            resumed=resume is not None,
+            n0=1 if resume is None else None, t0=t0)
+        try:
+            return self._check_impl(tel, t0, init_override, checkpoint,
+                                    checkpoint_every_s, resume, deadline_s)
+        finally:
+            tel.close()
+
+    def _check_impl(self, tel, t0, init_override, checkpoint,
+                    checkpoint_every_s, resume, deadline_s) -> EngineResult:
         bounds = self.bounds
         init_py = init_override if init_override is not None \
             else interp.init_state(bounds)
         init_vec = interp.to_vec(init_py, bounds)
         hi0, lo0 = sym_mod.init_fingerprint(self.config, init_py, init_vec)
+        tel.run_start()
 
         for nm in self.config.invariants:
             if not inv_mod.py_invariant(nm)(init_py, bounds):
-                return EngineResult(
+                res = EngineResult(
                     n_states=1, diameter=0, n_transitions=0,
                     coverage=Counter(),
                     violation=Violation(nm, init_py, [(None, init_py)]),
                     levels=[1], wall_s=time.monotonic() - t0)
+                tel.run_end(res)
+                return res
 
         B = self.config.chunk
         # Incremental snapshots (save_checkpoint) extend the checkpoint
@@ -420,15 +438,16 @@ class StreamedEngine:
             for b_start in range(lvl_lo + blocks_done * Fcap, lvl_hi,
                                  Fcap):
                 b_rows = min(Fcap, lvl_hi - b_start)
-                blk = host.read(b_start, b_rows)
-                con = constore.read(b_start, b_rows)[:, 0].astype(bool)
-                if b_rows < Fcap:
-                    blk = np.concatenate([blk, np.zeros(
-                        (Fcap - b_rows, self.schema.P), np.int32)])
-                    con = np.concatenate(
-                        [con, np.zeros((Fcap - b_rows,), bool)])
-                fbuf = jnp.asarray(blk)
-                fcon = jnp.asarray(con)
+                with tel.phases.phase("upload") as ph:
+                    blk = host.read(b_start, b_rows)
+                    con = constore.read(b_start, b_rows)[:, 0].astype(bool)
+                    if b_rows < Fcap:
+                        blk = np.concatenate([blk, np.zeros(
+                            (Fcap - b_rows, self.schema.P), np.int32)])
+                        con = np.concatenate(
+                            [con, np.zeros((Fcap - b_rows,), bool)])
+                    fbuf, fcon = ph.sync((jnp.asarray(blk),
+                                          jnp.asarray(con)))
                 carry = carry._replace(c=jnp.int32(0))
                 block_done = False
                 while not block_done:
@@ -436,19 +455,27 @@ class StreamedEngine:
                             and time.monotonic() - t_warm > deadline_s):
                         complete = False
                         stopped = True
+                        tel.stop_requested("deadline")
                         break
                     t_seg = time.monotonic()
-                    steps_d, done_d, carry = self._segment(
-                        carry, fbuf, fcon, jnp.int32(budget),
-                        jnp.int32(paged), jnp.int32(b_start),
-                        jnp.int32(b_rows))
-                    n_states, fail_v, viol_v = map(int, jax.device_get(
-                        (carry.n_states, carry.fail, carry.viol_g)))
-                    paged = self._pageout(carry, host, constore, paged,
-                                          n_states)
-                    if on_progress is not None:
-                        on_progress(self._progress_stats(carry, t0,
-                                                         len(level_ends)))
+                    with tel.phases.phase("expand"):
+                        steps_d, done_d, carry = self._segment(
+                            carry, fbuf, fcon, jnp.int32(budget),
+                            jnp.int32(paged), jnp.int32(b_start),
+                            jnp.int32(b_rows))
+                        n_states, fail_v, viol_v = map(int, jax.device_get(
+                            (carry.n_states, carry.fail, carry.viol_g)))
+                    with tel.phases.phase("export"):
+                        paged = self._pageout(carry, host, constore, paged,
+                                              n_states)
+                    if tel.active:
+                        n_trans, cov = jax.device_get(
+                            (carry.n_trans, carry.cov))
+                        tel.segment(
+                            n_states=n_states, level=len(level_ends),
+                            n_transitions=acc64_int(n_trans),
+                            coverage=dict(aggregate_coverage(
+                                self.table, cov)))
                     if fail_v or viol_v >= 0:
                         stopped = True
                         break
@@ -465,9 +492,11 @@ class StreamedEngine:
                 # save_checkpoint: resume must never re-expand rows)
                 if checkpoint and (time.monotonic() - last_ckpt
                                    >= checkpoint_every_s):
-                    self.save_checkpoint(checkpoint, carry, host,
-                                         constore, paged, level_ends,
-                                         blocks_done, (hi0, lo0))
+                    with tel.phases.phase("snapshot"):
+                        self.save_checkpoint(checkpoint, carry, host,
+                                             constore, paged, level_ends,
+                                             blocks_done, (hi0, lo0))
+                    tel.checkpoint(checkpoint)
                     last_ckpt = time.monotonic()
             if stopped:
                 break
@@ -518,28 +547,13 @@ class StreamedEngine:
         host.close()
         constore.close()
 
-        return EngineResult(
+        result = EngineResult(
             n_states=n_states, diameter=len(levels_arr) - 1,
             n_transitions=acc64_int(n_trans), coverage=coverage,
             violation=violation, levels=levels_arr,
             wall_s=time.monotonic() - t0, complete=complete)
-
-    def _progress_stats(self, carry: SCarry, t0: float, lvl: int) -> dict:
-        n_states, n_trans, cov = jax.device_get(
-            (carry.n_states, carry.n_trans, carry.cov))
-        wall = time.monotonic() - t0
-        n_states, n_trans = int(n_states), acc64_int(n_trans)
-        agg = dict(aggregate_coverage(self.table, cov))
-        return {
-            "wall_s": round(wall, 3),
-            "n_states": n_states,
-            "level": lvl,
-            "n_transitions": n_trans,
-            "dedup_hit_rate": round(
-                max(0.0, 1.0 - n_states / max(n_trans, 1)), 4),
-            "states_per_sec": round(n_states / max(wall, 1e-9), 1),
-            "coverage": agg,
-        }
+        tel.run_end(result)
+        return result
 
 
 def check(config: CheckConfig, caps: StreamedCapacities | None = None,

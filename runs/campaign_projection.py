@@ -8,46 +8,33 @@ given stop deadline.
 
 Usage: python runs/campaign_projection.py [stop_utc_HH:MM] [STATS_PATH]
 
-STATS_PATH (any argument without a ':') is the live stats stream to
-project from; default runs/elect5ddd.stats.
+STATS_PATH (any argument without a ':') is the live stats stream —
+either a v1 event log (--events) or a legacy .stats stream — to project
+from; default runs/elect5ddd.stats.
+
+Thin client of raft_tla_tpu.obs.monitor: all parsing (resume wall
+rebasing, checkpoint-rollback dropping, legacy-line lifting) lives
+there; this script keeps only the campaign-specific projection math
+(pace vs the r4 record, landmarks, stop-deadline budget).
 """
 import datetime
-import json
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from raft_tla_tpu.obs.monitor import load_stream
 
 RUNS = os.path.dirname(os.path.abspath(__file__))
 
 
 def load(name):
-    """Parse a stats stream; rebase wall_s to a cumulative clock across
-    in-file resumes (each resume resets the runner's wall_s to ~0), then
-    drop flush lines whose n_states sits below the running maximum —
-    a checkpoint rollback (elect5ddd_r4_final.stats has one at L30:
-    693,861,831 -> 677,888,262) replays counts the surviving timeline
-    already passed, and interpolating against the pre-rollback lines
-    would bind the pace ratio to a discarded wall clock."""
-    out = []
-    offset = prev = 0.0
-    with open(name if os.path.sep in name else os.path.join(RUNS, name)) \
-            as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            d = json.loads(line)
-            if d["wall_s"] < prev:
-                offset += prev
-            prev = d["wall_s"]
-            d = dict(d, wall_s=d["wall_s"] + offset)
-            out.append(d)
-    n_max = -1
-    kept = []
-    for d in out:
-        if d["n_states"] >= n_max:
-            kept.append(d)
-            n_max = d["n_states"]
-    return kept
+    """Segments of an event log or legacy stats stream, on the
+    cumulative (resume-rebased, rollback-dropped) clock."""
+    path = name if os.path.sep in name else os.path.join(RUNS, name)
+    segs = load_stream(path)["segments"]
+    return [dict(d, wall_s=d["cum_wall_s"]) for d in segs]
 
 
 def main():

@@ -13,10 +13,11 @@
 #   - after exit, the TPU worker claim needs ~10 min to release before any
 #     other process may touch the chip (8d92f00: 2.5 min relaunch wedged,
 #     10 min pause ran first try).
-# Usage: campaign_stop.sh [ENDPOINT_OUT] [STATS_FILE]
+# Usage: campaign_stop.sh [ENDPOINT_OUT] [STATS_FILE] [EVENTS_FILE]
 set -u
 OUT=${1:-/root/repo/runs/elect5ddd_r5b.out}
 STATS=${2:-/root/repo/runs/elect5ddd.stats}
+EVENTS=${3:-/root/repo/runs/elect5ddd.events}
 # match the python invocation itself, not wrappers/editors whose argv
 # happens to mention the script (an r5 near-miss: pgrep -f matched the
 # tail -f watching the log)
@@ -30,6 +31,13 @@ if [ "${#MAPFILE[@]}" -gt 1 ]; then
     exit 3
 fi
 PID=${MAPFILE[0]}
+# mark WHY the run is about to stop in the event log BEFORE signaling:
+# a run_end that follows a stop_requested is a clean operator stop, one
+# without it is a crash — the attribution the r4 postmortem lacked.
+# Best-effort: a missing/readonly log must never block the stop itself.
+PYTHONPATH=/root/repo python3 -m raft_tla_tpu.obs emit "$EVENTS" \
+    stop_requested --reason clean-stop --source campaign_stop.sh \
+    --pid "$PID" 2>/dev/null || true
 echo "SIGINT -> $PID at $(date -u +%H:%M:%S)"
 kill -INT "$PID"
 for i in $(seq 1 180); do

@@ -9,7 +9,8 @@ DDD engine, whose exact dedup lives in host RAM (~15B-state capacity).
 Usage: python runs/elect5_ddd.py [resume] [--seg-rows E] [--route K] [--cpu]
 (--seg-rows E sets DDDCapacities.seg_rows = 2**E -- checkpoint-compatible.)
 Checkpoints at runs/elect5ddd.ckpt every 15 min; stats stream appended to
-runs/elect5ddd.stats (one JSON line per flush/level).  ``--route K``
+runs/elect5ddd.stats (one JSON line per flush/level); run-event log
+appended to runs/elect5ddd.events (tail it live with raft-tla-monitor).  ``--route K``
 switches to the EP-routed step (DDDCapacities.route_rows=K) —
 checkpoint-compatible either way (tests/test_ddd_engine.py::
 test_routed_checkpoint_crosses_step_switch).
@@ -29,6 +30,7 @@ from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
 RUNS = os.path.dirname(os.path.abspath(__file__))
 CKPT = os.path.join(RUNS, "elect5ddd.ckpt")
 STATS = os.path.join(RUNS, "elect5ddd.stats")
+EVENTS = os.path.join(RUNS, "elect5ddd.events")
 
 CFG = CheckConfig(
     bounds=Bounds(n_servers=5, n_values=2, max_term=2, max_log=0,
@@ -80,7 +82,8 @@ def main():
 
     eng = DDDEngine(CFG, caps)
     r = eng.check(on_progress=on_progress, checkpoint=CKPT,
-                  checkpoint_every_s=900.0, resume=resume)
+                  checkpoint_every_s=900.0, resume=resume,
+                  events=EVENTS)
     print(json.dumps({
         "n_states": r.n_states, "diameter": r.diameter,
         "n_transitions": r.n_transitions, "complete": r.complete,

@@ -1,0 +1,116 @@
+"""Obs off-path overhead A/B (ISSUE 5 acceptance gate).
+
+Claim under test: with no listener attached (no ``--events``, no
+``on_progress``, phase timers off), the RunTelemetry integration costs
+nothing measurable — ``tel.active`` is False so the engines skip every
+per-segment device fetch, and ``phases.phase()`` returns a shared no-op
+handle.  The priced arms then show what turning the instruments ON
+costs: the events log (async writer + per-segment fetch) and the phase
+timers (a device sync per phase — the documented pipelining trade).
+
+Protocol (the chip-state-fiducial discipline of RESULTS.md "sig-prune
+A/B"): arms interleave round-robin so machine drift hits all arms
+equally, and every rep carries a fiducial — a synthetic jitted step +
+64 MB device copy timed immediately before the engine run — so a drifted
+rep is visible in the artifact instead of silently biasing a mean.
+
+Space: 3-server/2-value election t2/m2 (2,053,427 states, diameter 33),
+device engine, chunk 1024 — ~60 s/rep on the container CPU, large
+enough that a per-segment cost would integrate into the wall.
+
+Usage: python runs/obs_overhead_ab.py [reps]   (default 3)
+Appends one JSON line per rep + a summary line to runs/bench_obs_ab.out.
+"""
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.device_engine import Capacities, DeviceEngine
+from raft_tla_tpu.obs.phases import ENV_PHASE_TIMERS
+
+RUNS = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(RUNS, "bench_obs_ab.out")
+
+CFG = CheckConfig(
+    bounds=Bounds(n_servers=3, n_values=2, max_term=2, max_log=0,
+                  max_msgs=2),
+    spec="election", invariants=("NoTwoLeaders",), chunk=1024)
+CAPS = Capacities(n_states=1 << 21, levels=64)
+N_EXPECT = 2_053_427
+
+
+def fiducial() -> dict:
+    """Synthetic step + copy, jitted and timed warm (chip/CPU weather)."""
+    x = jnp.arange(1 << 24, dtype=jnp.uint32)          # 64 MB
+
+    @jax.jit
+    def step(v):
+        return (v * jnp.uint32(2654435761) ^ (v >> 7)).sum()
+
+    step(x).block_until_ready()                        # compile
+    t0 = time.monotonic()
+    step(x).block_until_ready()
+    step_ms = (time.monotonic() - t0) * 1e3
+    t0 = time.monotonic()
+    jnp.array(x, copy=True).block_until_ready()
+    copy_ms = (time.monotonic() - t0) * 1e3
+    return {"synthetic_step_ms": round(step_ms, 2),
+            "copy_64mb_ms": round(copy_ms, 2)}
+
+
+def run_arm(arm: str, tmp: str) -> float:
+    events = None
+    os.environ.pop(ENV_PHASE_TIMERS, None)
+    if arm != "off":
+        events = os.path.join(tmp, f"{arm}-{time.monotonic_ns()}.events")
+    if arm == "events+timers":
+        os.environ[ENV_PHASE_TIMERS] = "1"
+    t0 = time.monotonic()
+    r = DeviceEngine(CFG, CAPS).check(events=events)
+    wall = time.monotonic() - t0
+    os.environ.pop(ENV_PHASE_TIMERS, None)
+    assert r.n_states == N_EXPECT and r.complete, (arm, r.n_states)
+    return wall
+
+
+def main():
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    arms = ("off", "events", "events+timers")
+    walls: dict = {a: [] for a in arms}
+    with tempfile.TemporaryDirectory() as tmp, open(OUT, "a") as out:
+        for rep in range(reps):
+            for arm in arms:                 # interleaved: drift is shared
+                fid = fiducial()
+                wall = run_arm(arm, tmp)
+                line = {"rep": rep, "arm": arm, "wall_s": round(wall, 2),
+                        "platform": jax.default_backend(), **fid}
+                print(json.dumps(line))
+                out.write(json.dumps(line) + "\n")
+                out.flush()
+                walls[arm].append(wall)
+        med = {a: statistics.median(w) for a, w in walls.items()}
+        summary = {
+            "summary": "obs_overhead_ab",
+            "n_states": N_EXPECT,
+            "reps": reps,
+            "median_wall_s": {a: round(m, 2) for a, m in med.items()},
+            "events_over_off": round(med["events"] / med["off"], 4),
+            "timers_over_off": round(med["events+timers"] / med["off"], 4),
+        }
+        print(json.dumps(summary))
+        out.write(json.dumps(summary) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,256 @@
+"""Cross-engine run-event conformance + obs unit tests.
+
+The conformance tests are the contract the obs/ package exists for:
+every engine family emits the SAME versioned event schema, so one
+monitor (and one campaign-projection client) reads all of them.  Each
+engine runs the tiny election universe, the resulting log is validated
+line by line against the strict schema, and the final ``run_end`` count
+must agree with the ``EngineResult`` — and across engines.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.obs import monitor
+from raft_tla_tpu.obs.events import (
+    SCHEMA_VERSION, EventLog, ProgressTracker, append_event, validate_event)
+from raft_tla_tpu.obs.phases import PhaseTimers
+
+CFG = CheckConfig(
+    bounds=Bounds(n_servers=2, n_values=1, max_term=2, max_log=0,
+                  max_msgs=2),
+    spec="election", invariants=("NoTwoLeaders",), chunk=32)
+N_TOY = 3014            # distinct states of the toy universe (oracle)
+
+
+def _read_log(path):
+    with open(path) as fh:
+        return [json.loads(line) for line in fh]
+
+
+def _assert_conformant(evs, engine):
+    """The schema contract: valid lines, run_start first, run_end last,
+    segments carrying the shared ProgressRecord fields."""
+    errs = [(e["event"], err) for e in evs for err in validate_event(e)]
+    assert not errs, errs[:5]
+    assert evs[0]["event"] == "run_start"
+    assert evs[0]["engine"] == engine
+    assert evs[0]["universe"] == {"servers": 2, "values": 1}
+    assert evs[-1]["event"] == "run_end"
+    assert evs[-1]["outcome"] == "ok" and evs[-1]["complete"]
+    segs = [e for e in evs if e["event"] == "segment"]
+    assert segs, f"{engine}: no segment events"
+    for s in segs:
+        assert s["v"] == SCHEMA_VERSION
+        assert s["since_resume"] is True
+        # per-invariant evaluation counts (TLC -coverage 1 analogue):
+        # every generated state was checked against every invariant
+        assert s["inv_evals"] == {"NoTwoLeaders": s["n_transitions"]}
+    # level_end events appear whenever a level transition is observed
+    # between segments (always for the ddd family, pacing-dependent for
+    # table engines whose budget can cross several levels per segment)
+    ends = [e["level"] for e in evs if e["event"] == "level_end"]
+    assert ends == sorted(ends)
+    return evs[-1]["n_states"]
+
+
+def _run_engine(name, events, on_progress=None):
+    if name == "device":
+        from raft_tla_tpu.device_engine import Capacities, DeviceEngine
+        eng = DeviceEngine(CFG, Capacities(n_states=1 << 15, levels=64))
+    elif name == "paged":
+        from raft_tla_tpu.paged_engine import PagedCapacities, PagedEngine
+        eng = PagedEngine(CFG, PagedCapacities(ring=16384, table=1 << 15,
+                                               levels=64))
+    elif name == "streamed":
+        from raft_tla_tpu.streamed_engine import (StreamedCapacities,
+                                                  StreamedEngine)
+        eng = StreamedEngine(CFG, StreamedCapacities(
+            block=256, ring=4096, table=1 << 14, levels=64))
+    elif name == "ddd":
+        from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+        eng = DDDEngine(CFG, DDDCapacities(block=256, table=1 << 14,
+                                           flush=1 << 10, levels=64))
+    elif name == "shard":
+        from raft_tla_tpu.parallel import (ShardCapacities, ShardEngine,
+                                           make_mesh)
+        eng = ShardEngine(CFG, make_mesh(8),
+                          ShardCapacities(n_states=1 << 12, levels=64))
+    elif name == "pagedshard":
+        from raft_tla_tpu.parallel.paged_shard_engine import (
+            PagedShardCapacities, PagedShardEngine)
+        from raft_tla_tpu.parallel.shard_engine import make_mesh
+        eng = PagedShardEngine(CFG, make_mesh(8), PagedShardCapacities(
+            ring=4096, table=1 << 12, levels=64))
+    else:
+        from raft_tla_tpu.parallel.ddd_shard_engine import (
+            DDDShardCapacities, DDDShardEngine)
+        eng = DDDShardEngine(CFG, caps=DDDShardCapacities(
+            block=256, table=1 << 14, flush=1 << 10, levels=64))
+    return eng.check(events=events, on_progress=on_progress)
+
+
+@pytest.mark.smoke
+@pytest.mark.parametrize("engine", ["device", "paged", "streamed", "ddd"])
+def test_event_conformance_single_device(engine, tmp_path):
+    path = str(tmp_path / f"{engine}.events")
+    lines = []
+    res = _run_engine(engine, path, on_progress=lines.append)
+    evs = _read_log(path)
+    n = _assert_conformant(evs, engine)
+    assert n == res.n_states == N_TOY
+    if engine in ("streamed", "ddd"):  # boundary-exact level accounting
+        assert [e["level"] for e in evs if e["event"] == "level_end"]
+    # on_progress receives the same records the log's segments carry
+    segs = [e for e in evs if e["event"] == "segment"]
+    assert len(lines) == len(segs)
+    for cb, seg in zip(lines, segs):
+        assert cb["n_states"] == seg["n_states"]
+        assert cb["inc_states_per_sec"] == seg["inc_states_per_sec"]
+
+
+@pytest.mark.smoke
+@pytest.mark.slow
+@pytest.mark.parametrize("engine", ["shard", "pagedshard", "ddd-shard"])
+def test_event_conformance_sharded(engine, tmp_path):
+    path = str(tmp_path / "shard.events")
+    res = _run_engine(engine, path)
+    evs = _read_log(path)
+    n = _assert_conformant(evs, engine)
+    assert n == res.n_states == N_TOY
+    assert evs[0]["n_devices"] >= 1
+
+
+# --------------------------------------------------------------------------
+# schema unit tests
+
+
+def test_validate_rejects_unknowns_and_type_drift():
+    ok = {"v": 1, "event": "level_end", "ts": 0.0, "level": 3,
+          "n_states": 10}
+    assert validate_event(ok) == []
+    assert validate_event({**ok, "event": "levelend"})      # unknown event
+    assert validate_event({**ok, "extra": 1})               # unknown field
+    assert validate_event({**ok, "level": "3"})             # type drift
+    assert validate_event({**ok, "level": True})            # bool is not int
+    assert validate_event({**ok, "v": 2})                   # version bump
+    assert validate_event({"v": 1, "event": "level_end", "ts": 0.0,
+                           "level": 3})                     # missing field
+
+
+def test_append_event_validates(tmp_path):
+    p = str(tmp_path / "x.events")
+    append_event(p, "stop_requested", reason="clean-stop", source="test")
+    with pytest.raises(ValueError):
+        append_event(p, "stop_requested", source="test")  # missing reason
+    with pytest.raises(ValueError):
+        append_event(p, "no_such_event", reason="x")
+    evs = _read_log(p)
+    assert len(evs) == 1 and validate_event(evs[0]) == []
+
+
+def test_tracker_incremental_rate_immune_to_resume():
+    """Satellite (a): cumulative states/s inflated after a resume
+    (prior-process states over this-process wall); the incremental rate
+    and the since_resume tag carry the honest signal."""
+    tr = ProgressTracker(t0=time.monotonic() - 100.0,  # 100s in already
+                         n0=1, resumed=True)
+    tr.anchor(1_000_000)                  # checkpoint-restored count
+    rec = tr.record(n_states=1_000_050, level=7, n_transitions=2_000_000)
+    assert rec.since_resume is False      # cumulative fields span processes
+    assert rec.states_per_sec > 5_000     # the inflated wart, tagged...
+    assert rec.inc_states_per_sec < 10    # ...while inc stays honest
+    # rollback-monotone anchor: an inclusive count below the running max
+    # never yields a negative rate
+    rec2 = tr.record(n_states=999_000, level=7, n_transitions=2_000_001,
+                     n_incl=999_500)
+    assert rec2.inc_states_per_sec == 0.0
+
+
+def test_tracker_unknown_baseline_first_record_anchors():
+    tr = ProgressTracker(t0=time.monotonic() - 10.0,
+                         n0=None)             # table-engine resume
+    rec = tr.record(n_states=500, level=3, n_transitions=900)
+    assert rec.inc_states_per_sec == 0.0      # anchor, not a fabricated rate
+    rec2 = tr.record(n_states=700, level=3, n_transitions=1300)
+    assert rec2.inc_states_per_sec > 0.0
+
+
+def test_event_log_round_trips(tmp_path):
+    p = str(tmp_path / "log.events")
+    log = EventLog(p)
+    for k in range(100):
+        log.emit("level_end", level=k, n_states=k * 10)
+    log.close()
+    evs = _read_log(p)
+    assert [e["level"] for e in evs] == list(range(100))
+    assert all(validate_event(e) == [] for e in evs)
+    log.close()                                   # idempotent
+
+
+def test_phase_timers_disabled_is_inert_enabled_accumulates():
+    off = PhaseTimers(enabled=False)
+    with off.phase("expand") as ph:
+        assert ph.sync(123) == 123                # pass-through
+    assert off.snapshot() == {}
+    on = PhaseTimers(enabled=True)
+    with on.phase("expand") as ph:
+        ph.sync((1, 2))
+    with on.phase("expand"):
+        pass
+    snap = on.snapshot()
+    assert set(snap) == {"expand"} and snap["expand"] >= 0.0
+    assert on.snapshot() == {}                    # snapshot(reset=True)
+
+
+# --------------------------------------------------------------------------
+# monitor
+
+
+def test_load_stream_lifts_legacy_and_rebases_walls():
+    stream = monitor.load_stream("runs/elect5ddd_r5a.stats")
+    assert stream["legacy"] and not stream["invalid"]
+    segs = stream["segments"]
+    assert segs
+    cum = [s["cum_wall_s"] for s in segs]
+    assert cum == sorted(cum)                     # one monotone clock
+    ns = [s["n_states"] for s in segs]
+    assert ns == sorted(ns)                       # rollbacks dropped
+    hb = monitor.heartbeat(monitor.summarize(stream))
+    assert hb.startswith("L") and "inc" in hb
+
+
+def test_monitor_reads_v1_log_end_to_end(tmp_path):
+    p = str(tmp_path / "run.events")
+    _run_engine("ddd", p)
+    stream = monitor.load_stream(p)
+    assert not stream["legacy"] and not stream["invalid"]
+    s = monitor.summarize(stream)
+    assert s["status"] == "ok" and s["n_states"] == N_TOY
+    assert s["level_sizes"]                       # from level_end events
+    assert sum(s["level_sizes"].values()) <= N_TOY
+    assert "ok" in monitor.heartbeat(s)
+    assert monitor.main([p]) == 0                 # CLI one-shot
+
+
+def test_obs_emit_cli_interleaves_with_log(tmp_path):
+    p = str(tmp_path / "x.events")
+    append_event(p, "checkpoint", path="ck.npz", n_states=5)
+    r = subprocess.run(
+        [sys.executable, "-m", "raft_tla_tpu.obs", "emit", p,
+         "stop_requested", "--reason", "clean-stop",
+         "--source", "campaign_stop.sh", "--pid", "42"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    evs = _read_log(p)
+    assert [e["event"] for e in evs] == ["checkpoint", "stop_requested"]
+    assert evs[-1]["pid"] == 42
+    bad = subprocess.run(
+        [sys.executable, "-m", "raft_tla_tpu.obs", "emit", p, "bogus"],
+        capture_output=True, text=True)
+    assert bad.returncode != 0 and len(_read_log(p)) == 2

@@ -19,3 +19,6 @@ python -m raft_tla_tpu.lint runs/MC3s2v.cfg "$@"
 echo "== pytest smoke collection =="
 python -m pytest tests/ -m smoke --collect-only -q -p no:cacheprovider \
     --continue-on-collection-errors | tail -2
+
+echo "== obs smoke (event schema conformance) =="
+python -m pytest tests/test_obs.py -m smoke -q -p no:cacheprovider | tail -2
