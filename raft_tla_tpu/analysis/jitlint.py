@@ -46,6 +46,7 @@ DEFAULT_TARGETS = (
     "raft_tla_tpu/ddd_engine.py",
     "raft_tla_tpu/parallel",
     "raft_tla_tpu/obs",
+    "raft_tla_tpu/serve",
 )
 
 _NARROW_DTYPES = {"int8", "int16", "uint8", "uint16", "bfloat16", "float16",

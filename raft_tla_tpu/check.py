@@ -247,76 +247,18 @@ def build_argparser() -> argparse.ArgumentParser:
 
 
 def _resolve_config(args):
-    from raft_tla_tpu.config import Bounds, CheckConfig
-    from raft_tla_tpu.models import invariants as inv_mod
-    from raft_tla_tpu.utils import cfgparse
+    # One code path with the serve/ admission gate: the CLI flags become a
+    # JobOptions and the shared builder does every validation.
+    from raft_tla_tpu.serve.jobs import JobOptions, resolve_check_config
     from raft_tla_tpu.utils.cfgparse import load_cfg
 
-    cfg = load_cfg(args.cfg)
-    if cfg.specification not in (None, "Spec"):
-        raise ValueError(
-            f"unsupported SPECIFICATION {cfg.specification!r}: the compiled "
-            "model implements Spec == Init /\\ [][Next]_vars (raft.tla:469)")
-    # INIT/NEXT-style configs: only the spec's own operators are compiled;
-    # any other name would silently run a different model.
-    if cfg.init not in (None, "Init") or cfg.next not in (None, "Next"):
-        raise ValueError(
-            f"unsupported INIT/NEXT ({cfg.init!r}/{cfg.next!r}): only the "
-            "spec's Init (raft.tla:155-160) and Next (raft.tla:454-465) "
-            "are compiled")
-    # Unknown names fail at resolve time with the offending cfg line and
-    # a did-you-mean (one resolver, shared with the Pass 2 lint).
-    cfgparse.resolve_names(cfg.invariants, inv_mod.REGISTRY, "invariant",
-                           cfg=cfg, path=args.cfg)
-    from raft_tla_tpu.models import liveness as live_mod
-    for nm in cfg.properties:
-        live_mod.parse_property(nm)     # raises with both registries
-    sym_names = set(cfg.symmetry) | ({"Server"} if args.symmetry else set())
-    bad_sym = sym_names - {"Server", "SymServer", "Value", "SymValue",
-                           "SymServerValue"}
-    if bad_sym:
-        raise ValueError(
-            f"SYMMETRY {sorted(bad_sym)} not supported: Server and/or "
-            "Value permutation symmetry (name them Server/SymServer, "
-            "Value/SymValue, or the combined SymServerValue)")
-    symmetry = tuple(ax for ax in ("Server", "Value")
-                     if {ax, f"Sym{ax}"} & sym_names
-                     or "SymServerValue" in sym_names)
-    # Our own --emit-tlc artifacts declare the constraint/view this checker
-    # builds in; anything else would be silently unchecked.
-    if [c for c in cfg.constraints if c != "StateConstraint"]:
-        raise ValueError(
-            f"CONSTRAINT {cfg.constraints} not supported: the state "
-            "constraint is the built-in bound, set via --max-* flags "
-            "(emitted to TLC as 'StateConstraint')")
-    if args.faithful:
-        # Faithful mode fingerprints FULL states; accepting a cfg that
-        # declares the history-stripping view would silently contradict
-        # what stock TLC does with that very cfg.
-        if cfg.view is not None:
-            raise ValueError(
-                f"VIEW {cfg.view} contradicts --faithful: faithful mode "
-                "fingerprints full states (no view); re-emit the TLC twin "
-                "with --faithful --emit-tlc")
-    elif cfg.view not in (None, "ParityView"):
-        raise ValueError(
-            f"VIEW {cfg.view} not supported: parity mode fingerprints "
-            "under the built-in history-free ParityView")
-    bounds = Bounds(
-        n_servers=len(cfg.server_names()),
-        n_values=len(cfg.value_names()),
-        max_term=args.max_term, max_log=args.max_log,
+    opts = JobOptions(
+        spec=args.spec, max_term=args.max_term, max_log=args.max_log,
         max_msgs=args.max_msgs, max_dup=args.max_dup,
-        history=args.faithful, max_elections=args.max_elections)
-    props = list(cfg.properties) + [nm for nm in args.property
-                                     if nm not in cfg.properties]
-    for nm in props:
-        live_mod.parse_property(nm)     # raises with both registries
-    return CheckConfig(bounds=bounds, spec=args.spec,
-                       invariants=tuple(cfg.invariants), symmetry=symmetry,
-                       chunk=args.chunk,
-                       check_deadlock=args.deadlock,
-                       view=args.view), tuple(props)
+        faithful=args.faithful, max_elections=args.max_elections,
+        chunk=args.chunk, symmetry=args.symmetry, view=args.view,
+        deadlock=args.deadlock, properties=tuple(args.property))
+    return resolve_check_config(load_cfg(args.cfg), opts, path=args.cfg)
 
 
 def _stats_cb(args):
@@ -335,7 +277,9 @@ def _simulate(args, config):
     from raft_tla_tpu.simulate import Simulator
     sim = Simulator(config, walkers=args.walkers, depth=args.depth,
                     seed=args.seed)
-    res = sim.run(args.simulate)
+    # --stats/--events flow through the same RunTelemetry facade as the
+    # exhaustive engines (the events path rides the env set in main()).
+    res = sim.run(args.simulate, on_progress=_stats_cb(args))
     print(f"{res.n_behaviors} behaviors generated ({res.n_states} states, "
           f"deepest {res.max_depth_seen}), {res.wall_s:.2f}s "
           f"({res.states_per_sec:,.0f} states/s).")

@@ -1,0 +1,35 @@
+"""Multi-tenant check service — checking as serving (ROADMAP §5).
+
+One process, many bounded check jobs.  The paper's capability is one
+exhaustive check of one cfg per process; every ingredient of a *service*
+already shipped job-shaped — the byte-compatible cfg parser (L5), the
+speclint per-cfg admission verdicts (analysis/), and the versioned obs/
+event stream as a per-job progress API — and this package is the
+subsystem that accepts N jobs and amortizes device dispatch across them:
+
+- :mod:`raft_tla_tpu.serve.jobs` — the :class:`CheckJob` spec (cfg text +
+  bounds + invariants + engine options), the shared cfg→CheckConfig
+  builder ``resolve_check_config`` (one code path for check.py and the
+  server), and speclint-gated :func:`admit` (width-unsafe or vacuous
+  configs are rejected with the lint findings as the error payload,
+  before any device time is spent).
+- :mod:`raft_tla_tpu.serve.batch` — the lane-packed batch executor:
+  admitted jobs are binned by step signature (packed state width +
+  compiled step identity) and lane-tagged into shared fused-step
+  dispatches, so one vmapped dispatch advances N independent BFS
+  frontiers per chunk, with per-lane completion, per-lane invariant
+  verdicts and lane backfill as jobs finish (continuous batching).
+  Correctness anchor: each lane's reachable-state/orbit counts are
+  byte-identical to a solo ``engine.Engine`` run of the same cfg.
+- :mod:`raft_tla_tpu.serve.service` — the front: ``raft-tla-serve`` /
+  ``python -m raft_tla_tpu.serve`` consumes a JSONL job manifest or a
+  job-queue directory, emits one obs/ SCHEMA_VERSION=1 event log per
+  job (``raft-tla-monitor`` works unchanged per tenant), and isolates
+  tenants by per-job config digests in every result record.
+"""
+
+from raft_tla_tpu.serve.jobs import (Admission, CheckJob, JobOptions,
+                                     admit, resolve_check_config)
+
+__all__ = ["Admission", "CheckJob", "JobOptions", "admit",
+           "resolve_check_config"]

@@ -1,0 +1,5 @@
+"""``python -m raft_tla_tpu.serve`` — same front as ``raft-tla-serve``."""
+
+from raft_tla_tpu.serve.service import entry
+
+entry()

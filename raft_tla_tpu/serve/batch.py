@@ -1,0 +1,433 @@
+"""Lane-packed batch executor — N independent checks per fused dispatch.
+
+The continuous-batching shape that makes inference stacks fast, applied
+to model checking: admitted small-universe jobs are binned by **step
+signature** (bounds + spec subset + invariants + symmetry + view — the
+exact tuple ``ops/kernels.build_step`` compiles, which pins the packed
+state width), and each bin's lanes share ONE compiled fused step.  Every
+dispatch packs rows from all of the bin's live frontiers into one
+``[B, W]`` chunk — lane-tagged on the host, anonymous on the device —
+so a single vmapped step advances N independent BFS frontiers at once.
+As a lane completes, its chunk share backfills with the remaining
+lanes' rows on the very next dispatch (continuous batching, not static
+batching): the chunk stays full while any lane has work.
+
+Why this is fast for serving: a solo toy-universe run wastes most of
+its fixed-shape chunk on padding (BFS levels are narrower than B) and
+pays one jit compile per process; the batch pays one compile per *bin*
+and fills chunks across tenants.  Why it is sound: lanes never share
+dedup state — each lane owns its fingerprint set, store, parent links,
+coverage and level accounting, exactly the per-run state of
+``engine.Engine.check`` — so a lane's slice of a dispatch is processed
+with byte-for-byte the same logic as a solo chunk.  For runs that
+complete (no violation), counts are chunk-boundary-independent, hence
+**byte-identical to a solo run of the same cfg**; a violating lane's
+transition tally depends on its slice boundaries, the same way a solo
+Engine's depends on ``--chunk`` (the verdict and trace do not).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import Counter
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import CheckConfig
+from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation, _VecStore
+from raft_tla_tpu.models import interp, spec as S
+from raft_tla_tpu.obs import RunTelemetry
+from raft_tla_tpu.ops import fingerprint as fpr
+from raft_tla_tpu.ops import kernels
+from raft_tla_tpu.ops import state as st
+from raft_tla_tpu.ops import symmetry as sym_mod
+
+
+def bin_key(config: CheckConfig) -> tuple:
+    """The step-signature bin: everything ``build_step`` compiles over.
+
+    ``chunk`` is deliberately excluded — the executor imposes its own
+    shared chunk shape, so jobs differing only in requested chunk share
+    a bin (and a compile).
+    """
+    return (config.bounds, config.spec, tuple(config.invariants),
+            tuple(config.symmetry), config.view, config.check_deadlock)
+
+
+class _LaneFailure(Exception):
+    """A per-lane abort (capacity overflow, cap exceeded) — poisons the
+    lane, never the dispatch: the other tenants keep running."""
+
+
+@dataclasses.dataclass
+class LaneOutcome:
+    """One job's terminal state, service-attribution-ready."""
+
+    job_id: str
+    status: str                       # completed | violation | deadlock
+    #                                 # | stopped (lane failure)
+    result: Optional[EngineResult] = None
+    error: str | None = None
+
+
+class _Lane:
+    """One job's BFS state — the per-run state of ``engine.Engine.check``
+    factored out so N of them can interleave on one compiled step."""
+
+    def __init__(self, job_id: str, config: CheckConfig, table, lay,
+                 tel: RunTelemetry | None = None, init_override=None):
+        from raft_tla_tpu.models import invariants as inv_mod
+
+        self.job_id = job_id
+        self.config = config
+        self.table = table
+        self.A = len(table)
+        self.lay = lay
+        self.tel = tel
+        self.t0 = time.monotonic()
+
+        bounds = config.bounds
+        init_py = init_override if init_override is not None \
+            else interp.init_state(bounds)
+        init_vec = interp.to_vec(init_py, bounds)
+        hi0, lo0 = sym_mod.init_fingerprint(config, init_py, init_vec)
+        self.seen: set[int] = {int(fpr.to_u64(hi0, lo0))}
+        self.store = _VecStore(lay.width)
+        self.store.append(init_vec[None, :])
+        self.parents: list = [None]
+        self.coverage: Counter = Counter()
+        self.levels = [1]
+        self.n_transitions = 0
+        self.violation: Optional[Violation] = None
+        self.new_this_level = 0
+        self.next_frontier: list[int] = []
+        self.outcome: Optional[LaneOutcome] = None
+        self._pending = None
+
+        if tel is not None:
+            tel.run_start()
+        for nm in config.invariants:
+            if not inv_mod.py_invariant(nm)(init_py, bounds):
+                self.violation = self._make_violation(nm, 0)
+                break
+        self.frontier = [0] if self.violation is None and \
+            interp.constraint_ok(init_py, bounds) else []
+        self.cursor = 0
+        if self.violation is not None or not self.frontier:
+            self._finish()
+
+    # -- executor interface ---------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return self.outcome is None
+
+    def pending_rows(self) -> int:
+        return len(self.frontier) - self.cursor
+
+    def take(self, n: int):
+        """Claim the next ``n`` frontier rows: (gidx list, stacked vecs)."""
+        gidx = self.frontier[self.cursor:self.cursor + n]
+        self.cursor += len(gidx)
+        vecs = np.stack([self.store.get(g) for g in gidx])
+        return gidx, vecs
+
+    def scan_slice(self, valid, ovf, keys, inv_ok, con_ok, gidx) -> list:
+        """Phase 1 on this lane's slice of a dispatch (its 'chunk'):
+        dedup in discovery order, transition/deadlock accounting, and
+        the violation cut — ``engine.Engine.check`` semantics verbatim.
+        Returns slice-relative flat indices of accepted new states."""
+        A = self.A
+        if ovf.any():
+            _, a = np.argwhere(ovf)[0]
+            raise _LaneFailure(
+                "state-capacity overflow at "
+                f"{self.table[int(a)].label()} — bounds reasoning "
+                "violated (config.py capacity scheme)")
+        dead_limit = None
+        if self.config.check_deadlock:
+            dead = ~valid.any(axis=1)
+            if dead.any():
+                dead_limit = int(np.argmax(dead)) * A
+        flat_keys = keys.reshape(-1)
+        flat_valid = valid.reshape(-1)
+        if dead_limit is not None:
+            flat_valid = flat_valid.copy()
+            flat_valid[dead_limit:] = False
+        self.n_transitions += int(flat_valid.sum())
+        new_flat: list[int] = []
+        for fi in np.nonzero(flat_valid)[0]:
+            kk = int(flat_keys[fi])
+            if kk in self.seen:
+                continue
+            self.seen.add(kk)
+            new_flat.append(int(fi))
+        for t, fi in enumerate(new_flat):
+            b, a = divmod(fi, A)
+            if not inv_ok[b, a].all():
+                new_flat = new_flat[:t + 1]
+                break
+        self._pending = (new_flat, inv_ok, con_ok, gidx, dead_limit)
+        return new_flat
+
+    def commit_slice(self, rows: np.ndarray) -> None:
+        """Phase 2: append the gathered new-state rows and record
+        parents/coverage/verdicts in discovery order."""
+        new_flat, inv_ok, con_ok, gidx, dead_limit = self._pending
+        self._pending = None
+        inv_names = list(self.config.invariants)
+        if not new_flat:
+            if dead_limit is not None:
+                self.violation = self._make_violation(
+                    DEADLOCK, gidx[dead_limit // self.A])
+            return
+        base = len(self.store)
+        self.store.append(rows)
+        for t, fi in enumerate(new_flat):
+            b, a = divmod(fi, self.A)
+            g = base + t
+            self.parents.append((gidx[b], int(a)))
+            self.coverage[self.table[int(a)].family] += 1
+            self.new_this_level += 1
+            bad = np.nonzero(~inv_ok[b, a])[0]
+            if bad.size:
+                self.violation = self._make_violation(
+                    inv_names[int(bad[0])], g)
+                break
+            if bool(con_ok[b, a]):
+                self.next_frontier.append(g)
+        if self.violation is None and dead_limit is not None:
+            self.violation = self._make_violation(
+                DEADLOCK, gidx[dead_limit // self.A])
+
+    def advance(self, max_states: int | None) -> None:
+        """Post-slice lane control: violation stop, level promotion,
+        completion — with a per-lane segment event at each boundary."""
+        if self.violation is not None:
+            self._finish()
+            return
+        if self.cursor < len(self.frontier):
+            return                      # level still in flight
+        if self.new_this_level:
+            self.levels.append(self.new_this_level)
+        if self.tel is not None:
+            self.tel.segment(len(self.store), len(self.levels) - 1,
+                             self.n_transitions,
+                             coverage=dict(self.coverage))
+        if max_states is not None and len(self.store) > max_states:
+            raise _LaneFailure(f"state count exceeded {max_states}")
+        self.frontier = self.next_frontier
+        self.next_frontier = []
+        self.cursor = 0
+        self.new_this_level = 0
+        if not self.frontier:
+            self._finish()
+
+    def fail(self, message: str) -> None:
+        """Poison this lane (its tenants' verdict is 'stopped', with the
+        failure as the reason); the dispatch and the other lanes live."""
+        res = self._result(complete=False)
+        if self.tel is not None:
+            self.tel.stop_requested(message, source="serve")
+            self.tel.run_end(res)
+        self.outcome = LaneOutcome(self.job_id, "stopped", result=res,
+                                   error=message)
+
+    # -- internals ------------------------------------------------------------
+
+    def _result(self, complete: bool = True) -> EngineResult:
+        return EngineResult(
+            n_states=len(self.store), diameter=len(self.levels) - 1,
+            n_transitions=self.n_transitions, coverage=self.coverage,
+            violation=self.violation, levels=self.levels,
+            wall_s=time.monotonic() - self.t0, complete=complete)
+
+    def _finish(self) -> None:
+        res = self._result()
+        if self.violation is None:
+            status = "completed"
+        else:
+            status = "deadlock" if self.violation.invariant == DEADLOCK \
+                else "violation"
+        if self.tel is not None:
+            self.tel.run_end(res)
+        self.outcome = LaneOutcome(self.job_id, status, result=res)
+
+    def _make_violation(self, inv_name: str, gidx: int) -> Violation:
+        chain = []
+        cur: Optional[int] = gidx
+        while cur is not None:
+            py = interp.from_struct(
+                st.unpack(self.store.get(cur), self.lay, np),
+                self.config.bounds)
+            entry = self.parents[cur]
+            label = self.table[entry[1]].label() if entry else None
+            chain.append((label, py))
+            cur = entry[0] if entry else None
+        chain.reverse()
+        return Violation(invariant=inv_name, state=chain[-1][1], trace=chain)
+
+
+class _Bin:
+    """One step signature: a compiled fused step + the lanes sharing it."""
+
+    def __init__(self, key: tuple, config: CheckConfig):
+        self.key = key
+        self.bounds = config.bounds
+        self.lay = st.Layout.of(config.bounds)
+        self.table = S.action_table(config.bounds, config.spec)
+        self.A = len(self.table)
+        self.step = jax.jit(kernels.build_step(
+            config.bounds, config.spec, tuple(config.invariants),
+            tuple(config.symmetry), view=config.view))
+        self.lanes: list[_Lane] = []
+        self.rr = 0                     # round-robin fill offset
+
+    def live_lanes(self) -> list:
+        return [ln for ln in self.lanes if ln.active]
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1)).bit_length()
+
+
+class BatchExecutor:
+    """Run N admitted jobs with shared, lane-packed fused dispatches.
+
+    ``chunk`` is the shared dispatch width ``B`` (every bin compiles one
+    ``[B, W]`` step); ``max_states`` is a per-lane cap mirroring
+    ``engine.Engine.check(max_states=)``.  ``run`` returns
+    ``{job_id: LaneOutcome}`` — one terminal record per job, always.
+    """
+
+    def __init__(self, chunk: int = 1024, max_states: int | None = None):
+        self.chunk = chunk
+        self.max_states = max_states
+
+    def run(self, jobs, telemetry: dict | None = None,
+            init_overrides: dict | None = None) -> dict:
+        """``jobs``: iterable of ``(job_id, CheckConfig)``; ``telemetry``
+        optionally maps job_id -> RunTelemetry (the service wires one
+        per-job event log each; callers owning none pass nothing).
+        ``init_overrides`` maps job_id -> PyState, mirroring the solo
+        engines' ``init_override`` hook (parity tests seed from it)."""
+        telemetry = telemetry or {}
+        init_overrides = init_overrides or {}
+        B = self.chunk
+        bins: dict[tuple, _Bin] = {}
+        outcomes: dict[str, LaneOutcome] = {}
+        lanes: list[_Lane] = []
+        for job_id, config in jobs:
+            if job_id in outcomes or any(ln.job_id == job_id
+                                         for ln in lanes):
+                raise ValueError(f"duplicate job id {job_id!r}")
+            key = bin_key(config)
+            bn = bins.get(key)
+            if bn is None:
+                bn = bins[key] = _Bin(key, config)
+            lane = _Lane(job_id, config, bn.table, bn.lay,
+                         tel=telemetry.get(job_id),
+                         init_override=init_overrides.get(job_id))
+            bn.lanes.append(lane)
+            lanes.append(lane)
+            if not lane.active:         # init-state verdict, no dispatch
+                outcomes[job_id] = lane.outcome
+
+        try:
+            while True:
+                progressed = False
+                for bn in bins.values():
+                    if self._dispatch(bn, B, outcomes):
+                        progressed = True
+                if not progressed:
+                    break
+        finally:
+            for lane in lanes:
+                if lane.tel is not None:
+                    lane.tel.close()
+        return {ln.job_id: outcomes[ln.job_id] for ln in lanes}
+
+    # -- internals ------------------------------------------------------------
+
+    def _dispatch(self, bn: _Bin, B: int, outcomes: dict) -> bool:
+        """Pack one chunk from the bin's live frontiers, run the fused
+        step once, demux per lane.  Returns False when the bin is idle."""
+        live = bn.live_lanes()
+        if not live:
+            return False
+        # Rotate the fill order so no lane monopolizes the chunk when the
+        # bin is oversubscribed; slots freed by finished lanes go to the
+        # survivors automatically (the backfill IS this fill loop).
+        order = live[bn.rr % len(live):] + live[:bn.rr % len(live)]
+        bn.rr += 1
+        slices = []                     # (lane, r0, nb, gidx)
+        parts = []
+        pos = 0
+        for lane in order:
+            if pos == B:
+                break
+            take = min(B - pos, lane.pending_rows())
+            if take <= 0:
+                continue
+            gidx, vecs = lane.take(take)
+            slices.append((lane, pos, take, gidx))
+            parts.append(vecs)
+            pos += take
+        if not slices:
+            return False
+        W = bn.lay.width
+        vecs = np.concatenate(parts, axis=0)
+        if pos < B:                     # pad to the static chunk shape
+            vecs = np.concatenate(
+                [vecs, np.broadcast_to(vecs[0], (B - pos, W))], axis=0)
+        out = bn.step(jnp.asarray(vecs))
+
+        valid = np.asarray(out["valid"])
+        ovf = np.asarray(out["overflow"])
+        keys = fpr.to_u64(np.asarray(out["fp_hi"]),
+                          np.asarray(out["fp_lo"]))
+        inv_ok = np.asarray(out["inv_ok"])
+        con_ok = np.asarray(out["con_ok"])
+
+        # Phase 1 per lane slice; collect the chunk-global flat indices
+        # of every accepted new state for one shared device gather.
+        sel_flat: list[int] = []
+        committing = []
+        for lane, r0, nb, gidx in slices:
+            sl = slice(r0, r0 + nb)
+            try:
+                new_flat = lane.scan_slice(valid[sl], ovf[sl], keys[sl],
+                                           inv_ok[sl], con_ok[sl], gidx)
+            except _LaneFailure as e:
+                lane.fail(str(e))
+                outcomes[lane.job_id] = lane.outcome
+                continue
+            committing.append((lane, len(new_flat)))
+            sel_flat.extend(r0 * bn.A + fi for fi in new_flat)
+
+        # One gather for the whole dispatch (padded to a pow2 bucket so
+        # the eager gather compiles O(log) distinct shapes), then split
+        # back per lane in chunk order.
+        n_new = len(sel_flat)
+        if n_new:
+            cap = _next_pow2(n_new)
+            sel = np.asarray(sel_flat + [0] * (cap - n_new), dtype=np.int64)
+            rows_all = np.asarray(
+                out["svecs"].reshape(B * bn.A, W)[jnp.asarray(sel)])[:n_new]
+        else:
+            rows_all = np.empty((0, W), dtype=np.int32)
+        off = 0
+        for lane, n_lane in committing:
+            lane.commit_slice(rows_all[off:off + n_lane])
+            off += n_lane
+            try:
+                lane.advance(self.max_states)
+            except _LaneFailure as e:
+                lane.fail(str(e))
+            if not lane.active:
+                outcomes[lane.job_id] = lane.outcome
+        return True

@@ -1,0 +1,279 @@
+"""Job model + admission — the service's L5/L6 seam.
+
+A :class:`CheckJob` is one tenant's bounded check: a TLC model config
+(text or path) plus the engine options the check.py CLI would take as
+flags, normalised into :class:`JobOptions`.  Two functions do all the
+work:
+
+- :func:`resolve_check_config` — the cfg→``CheckConfig`` builder that
+  used to be inlined in ``check.py._resolve_config``.  check.py and the
+  server now share this one code path (check.py is a thin single-job
+  client); every validation it performs is host-only.
+- :func:`admit` — the speclint gate: parse, build :class:`Bounds`, run
+  the Pass 1 width proof and the Pass 2 cfg lint, and reject
+  width-unsafe or vacuous configs *with the lint findings as the error
+  payload* — all before any step build, so a rejected job costs zero
+  device time.
+
+Tenant isolation: :meth:`CheckJob.digest` is a stable content hash of
+(cfg text, options).  The service stamps it into every result record
+and artifact name, the same role the checkpoint config digest plays for
+resumes — two tenants' outputs can never be silently conflated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+
+from raft_tla_tpu.analysis import report as _report
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.utils import cfgparse
+from raft_tla_tpu.utils.cfgparse import TLCConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class JobOptions:
+    """The engine-facing options of one check — the check.py flags that
+    shape the compiled model, minus anything about *where* it runs.
+    Field names match the CLI flags (``--max-term`` → ``max_term``)."""
+
+    spec: str = "full"
+    max_term: int = 3
+    max_log: int = 2
+    max_msgs: int = 4
+    max_dup: int = 1
+    faithful: bool = False
+    max_elections: int = 6
+    chunk: int = 1024
+    symmetry: bool = False          # --symmetry: force the Server axis
+    view: str | None = None         # --view: registered exact view
+    deadlock: bool = False
+    properties: tuple = ()          # --property additions (cfg's also read)
+
+
+def resolve_check_config(cfg: TLCConfig, opts: JobOptions,
+                         path: str | None = None):
+    """cfg + options -> ``(CheckConfig, properties)``; raises ValueError.
+
+    The single code path behind ``check.py`` and the server: stanza
+    support checks (SPECIFICATION/INIT/NEXT must name the compiled
+    spec), invariant/property resolution with did-you-mean, SYMMETRY
+    axis mapping, CONSTRAINT/VIEW compatibility, and the Bounds build.
+    """
+    from raft_tla_tpu.models import invariants as inv_mod
+    from raft_tla_tpu.models import liveness as live_mod
+
+    if cfg.specification not in (None, "Spec"):
+        raise ValueError(
+            f"unsupported SPECIFICATION {cfg.specification!r}: the compiled "
+            "model implements Spec == Init /\\ [][Next]_vars (raft.tla:469)")
+    # INIT/NEXT-style configs: only the spec's own operators are compiled;
+    # any other name would silently run a different model.
+    if cfg.init not in (None, "Init") or cfg.next not in (None, "Next"):
+        raise ValueError(
+            f"unsupported INIT/NEXT ({cfg.init!r}/{cfg.next!r}): only the "
+            "spec's Init (raft.tla:155-160) and Next (raft.tla:454-465) "
+            "are compiled")
+    # Unknown names fail at resolve time with the offending cfg line and
+    # a did-you-mean (one resolver, shared with the Pass 2 lint).
+    cfgparse.resolve_names(cfg.invariants, inv_mod.REGISTRY, "invariant",
+                           cfg=cfg, path=path)
+    for nm in cfg.properties:
+        live_mod.parse_property(nm)     # raises with both registries
+    sym_names = set(cfg.symmetry) | ({"Server"} if opts.symmetry else set())
+    bad_sym = sym_names - {"Server", "SymServer", "Value", "SymValue",
+                           "SymServerValue"}
+    if bad_sym:
+        raise ValueError(
+            f"SYMMETRY {sorted(bad_sym)} not supported: Server and/or "
+            "Value permutation symmetry (name them Server/SymServer, "
+            "Value/SymValue, or the combined SymServerValue)")
+    symmetry = tuple(ax for ax in ("Server", "Value")
+                     if {ax, f"Sym{ax}"} & sym_names
+                     or "SymServerValue" in sym_names)
+    # Our own --emit-tlc artifacts declare the constraint/view this checker
+    # builds in; anything else would be silently unchecked.
+    if [c for c in cfg.constraints if c != "StateConstraint"]:
+        raise ValueError(
+            f"CONSTRAINT {cfg.constraints} not supported: the state "
+            "constraint is the built-in bound, set via --max-* flags "
+            "(emitted to TLC as 'StateConstraint')")
+    if opts.faithful:
+        # Faithful mode fingerprints FULL states; accepting a cfg that
+        # declares the history-stripping view would silently contradict
+        # what stock TLC does with that very cfg.
+        if cfg.view is not None:
+            raise ValueError(
+                f"VIEW {cfg.view} contradicts --faithful: faithful mode "
+                "fingerprints full states (no view); re-emit the TLC twin "
+                "with --faithful --emit-tlc")
+    elif cfg.view not in (None, "ParityView"):
+        raise ValueError(
+            f"VIEW {cfg.view} not supported: parity mode fingerprints "
+            "under the built-in history-free ParityView")
+    bounds = Bounds(
+        n_servers=len(cfg.server_names()),
+        n_values=len(cfg.value_names()),
+        max_term=opts.max_term, max_log=opts.max_log,
+        max_msgs=opts.max_msgs, max_dup=opts.max_dup,
+        history=opts.faithful, max_elections=opts.max_elections)
+    props = list(cfg.properties) + [nm for nm in opts.properties
+                                    if nm not in cfg.properties]
+    for nm in props:
+        live_mod.parse_property(nm)     # raises with both registries
+    return CheckConfig(bounds=bounds, spec=opts.spec,
+                       invariants=tuple(cfg.invariants), symmetry=symmetry,
+                       chunk=opts.chunk,
+                       check_deadlock=opts.deadlock,
+                       view=opts.view), tuple(props)
+
+
+# --------------------------------------------------------------------------
+# jobs
+
+
+# JobOptions fields a manifest/queue entry may set (everything except the
+# tuple-typed properties, which JSON lists map onto).
+_OPTION_KEYS = tuple(f.name for f in dataclasses.fields(JobOptions))
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckJob:
+    """One tenant's bounded check: identity + cfg + options.
+
+    ``cfg_text`` wins over ``cfg_path`` when both are set (a queue entry
+    may inline the config so the job file is self-contained); the digest
+    always covers the *text*, so the same model submitted by path or
+    inline hashes identically.
+    """
+
+    job_id: str
+    options: JobOptions = JobOptions()
+    cfg_path: str | None = None
+    cfg_text: str | None = None
+
+    def read_cfg_text(self) -> str:
+        if self.cfg_text is not None:
+            return self.cfg_text
+        if self.cfg_path is None:
+            raise ValueError(f"job {self.job_id!r} has neither cfg_text "
+                             "nor cfg_path")
+        with open(self.cfg_path, "r", encoding="utf-8") as f:
+            return f.read()
+
+    def digest(self) -> str:
+        """Stable content hash of (cfg text, options) — the tenant
+        isolation tag stamped into every result record."""
+        payload = json.dumps(
+            {"cfg": self.read_cfg_text(),
+             "options": dataclasses.asdict(self.options)},
+            sort_keys=True)
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+    @classmethod
+    def from_dict(cls, d: dict, job_id: str | None = None) -> "CheckJob":
+        """Build from one manifest/queue JSON object.  Unknown keys are
+        a hard error — a typo'd option silently running default bounds
+        is the cfg-footgun all over again."""
+        d = dict(d)
+        jid = d.pop("id", None) or job_id
+        if not jid:
+            raise ValueError(f"job entry has no 'id': {sorted(d)}")
+        cfg_path = d.pop("cfg", None)
+        cfg_text = d.pop("cfg_text", None)
+        props = d.pop("properties", ())
+        unknown = set(d) - set(_OPTION_KEYS)
+        if unknown:
+            raise ValueError(
+                f"job {jid!r}: unknown option(s) {sorted(unknown)} "
+                f"(known: id, cfg, cfg_text, {', '.join(_OPTION_KEYS)})")
+        opts = JobOptions(properties=tuple(props), **d)
+        return cls(job_id=str(jid), options=opts,
+                   cfg_path=cfg_path, cfg_text=cfg_text)
+
+
+# --------------------------------------------------------------------------
+# admission
+
+
+@dataclasses.dataclass
+class Admission:
+    """The speclint verdict for one job, findings attached either way.
+
+    ``admitted`` jobs carry the resolved ``config``/``properties`` the
+    executor runs; rejected jobs carry ``reason`` (stable kebab-case)
+    and the findings that justify it — the error payload the service
+    returns to the tenant.
+    """
+
+    job: CheckJob
+    admitted: bool
+    findings: list                       # analysis/report.Finding
+    config: CheckConfig | None = None
+    properties: tuple = ()
+    reason: str | None = None
+
+    def findings_text(self) -> list:
+        return [f.format() for f in self.findings]
+
+
+def admit(job: CheckJob) -> Admission:
+    """Gate one job through speclint — host-only, zero device time.
+
+    Reject paths, in order: unreadable/unparseable cfg; Bounds the
+    packed encodings cannot represent (width-unsafe by construction);
+    Pass 1 width-proof failures; Pass 2 cfg-lint errors (unknown names,
+    mode mismatches, constant/bounds conflicts); *vacuous invariants*
+    (a warning for the CLI, but a service must not bill device time for
+    a check that statically checks nothing); and any residual
+    resolve-time error.  The returned findings are the error payload.
+    """
+    from raft_tla_tpu.analysis import cfglint, widthcheck
+
+    opts = job.options
+    try:
+        cfg = cfgparse.parse_cfg(job.read_cfg_text())
+    except (OSError, ValueError) as e:
+        f = _report.Finding(_report.CFG, _report.ERROR, "cfg-unreadable",
+                            str(e), file=job.cfg_path)
+        return Admission(job, False, [f], reason="cfg-unreadable")
+
+    try:
+        bounds = Bounds(
+            n_servers=len(cfg.server_names()),
+            n_values=len(cfg.value_names()),
+            max_term=opts.max_term, max_log=opts.max_log,
+            max_msgs=opts.max_msgs, max_dup=opts.max_dup,
+            history=opts.faithful, max_elections=opts.max_elections)
+    except ValueError as e:
+        # The encodings cannot even represent these bounds: width-unsafe
+        # by construction (same lift analysis/__main__ applies).
+        f = _report.Finding(_report.WIDTH, _report.ERROR, "bounds-invalid",
+                            str(e), file=job.cfg_path)
+        findings = [f] + cfglint.lint_cfg(
+            cfg, Bounds(), spec=opts.spec, view=opts.view,
+            path=job.cfg_path)
+        return Admission(job, False, findings, reason="width-unsafe")
+
+    findings = list(widthcheck.check_widths(bounds, opts.spec))
+    if _report.has_errors(findings):
+        return Admission(job, False, findings, reason="width-unsafe")
+
+    findings += cfglint.lint_cfg(cfg, bounds, spec=opts.spec,
+                                 view=opts.view, path=job.cfg_path)
+    if _report.has_errors(findings):
+        return Admission(job, False, findings, reason="cfg-invalid")
+    vacuous = [f for f in findings if f.code == "invariant-vacuous"]
+    if vacuous:
+        return Admission(job, False, findings, reason="vacuous")
+
+    try:
+        config, props = resolve_check_config(cfg, opts, path=job.cfg_path)
+    except ValueError as e:
+        findings.append(_report.Finding(
+            _report.CFG, _report.ERROR, "resolve-failed", str(e),
+            file=job.cfg_path))
+        return Admission(job, False, findings, reason="cfg-invalid")
+    return Admission(job, True, findings, config=config, properties=props)

@@ -1,0 +1,280 @@
+"""The service front — ``raft-tla-serve`` / ``python -m raft_tla_tpu.serve``.
+
+One-pass multi-tenant driver: read a job source (JSONL manifest or a
+queue directory of per-job JSON files), admit every job through the
+speclint gate (``jobs.admit``), run all admitted jobs through the
+lane-packed :class:`~raft_tla_tpu.serve.batch.BatchExecutor`, and leave
+behind per-tenant artifacts:
+
+- ``OUT/<job_id>.events`` — one obs/ SCHEMA_VERSION=1 event log per job,
+  so ``raft-tla-monitor OUT/<job_id>.events`` renders any tenant's run
+  unchanged.  Rejected jobs get a three-event log (``run_start``,
+  ``stop_requested`` with the admission reason, ``run_end`` outcome
+  ``rejected``) so end-state attribution is uniform: a tenant's log
+  always says completed / rejected-at-admission / stopped.
+- ``OUT/results.jsonl`` — one record per job with the job's content
+  digest (:meth:`CheckJob.digest` — cfg text + options), verdict, counts
+  and findings.  The digest is the tenant-isolation tag: two jobs'
+  outputs can never be conflated, and a client can verify the result it
+  reads answers the exact model it submitted.
+
+Exit code: 0 when every admitted job reached a verdict (including
+violation/deadlock verdicts — finding a counterexample is the service
+working); 1 when any lane was stopped by a runtime failure or the job
+source itself was unreadable.  Admission rejects do not fail the
+service — they are per-tenant client errors, reported in the results.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+
+_JOB_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+
+def load_jobs(source: str) -> list:
+    """Read :class:`CheckJob` entries from a JSONL manifest file or a
+    queue directory of ``*.json`` job files (sorted name order — the
+    queue convention: producers write ``NNN-name.json``).
+
+    Job ids must be path-safe (``[A-Za-z0-9._-]``, no leading dot) since
+    they name the per-tenant event logs; duplicates are a hard error —
+    two tenants sharing a log would be the conflation the digests exist
+    to prevent.
+    """
+    from raft_tla_tpu.serve.jobs import CheckJob
+
+    entries: list[tuple[str | None, dict]] = []
+    if os.path.isdir(source):
+        names = sorted(n for n in os.listdir(source) if n.endswith(".json"))
+        if not names:
+            raise ValueError(f"queue directory {source!r} has no *.json jobs")
+        for n in names:
+            with open(os.path.join(source, n), "r", encoding="utf-8") as f:
+                entries.append((n[:-len(".json")], json.load(f)))
+    else:
+        with open(source, "r", encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                try:
+                    d = json.loads(line)
+                except ValueError as e:
+                    raise ValueError(
+                        f"{source}:{lineno}: not JSON: {e}") from e
+                entries.append((None, d))
+
+    # Relative cfg paths resolve against the job source's own directory —
+    # a manifest is self-contained wherever the service runs from.
+    base = source if os.path.isdir(source) else os.path.dirname(source)
+    jobs, seen = [], set()
+    for default_id, d in entries:
+        if d.get("cfg") and not os.path.isabs(d["cfg"]):
+            d = dict(d, cfg=os.path.join(base, d["cfg"]))
+        job = CheckJob.from_dict(d, job_id=default_id)
+        if not _JOB_ID_RE.match(job.job_id):
+            raise ValueError(
+                f"job id {job.job_id!r} is not path-safe "
+                "([A-Za-z0-9._-], no leading punctuation, <= 64 chars)")
+        if job.job_id in seen:
+            raise ValueError(f"duplicate job id {job.job_id!r}")
+        seen.add(job.job_id)
+        jobs.append(job)
+    return jobs
+
+
+def _events_path(out_dir: str, job_id: str) -> str:
+    return os.path.join(out_dir, f"{job_id}.events")
+
+
+def _reject_events(path: str, job, reason: str) -> None:
+    """The rejected-tenant event log: same schema, same monitor, explicit
+    attribution — a log is never silent about why a run has no states."""
+    from raft_tla_tpu.obs import append_event
+
+    append_event(path, "run_start", engine="serve", universe={}, spec="",
+                 invariants=[], resumed=False, pid=os.getpid())
+    append_event(path, "stop_requested",
+                 reason=f"rejected-at-admission: {reason}",
+                 source="admission", pid=os.getpid())
+    # One zero segment so the monitor's heartbeat (which needs a segment
+    # timeline) renders the rejection attribution instead of "no data".
+    append_event(path, "segment", wall_s=0.0, n_states=0, level=0,
+                 n_transitions=0, dedup_hit_rate=0.0, states_per_sec=0.0,
+                 inc_states_per_sec=0.0, since_resume=True)
+    append_event(path, "run_end", n_states=0, n_transitions=0,
+                 complete=False, outcome="rejected")
+
+
+def run_service(jobs, out_dir: str, chunk: int = 1024,
+                max_states: int | None = None, quiet: bool = False) -> list:
+    """Admit + execute + record: returns the results.jsonl records.
+
+    Split from the CLI so tests (and later fronts — a socket server, an
+    elastic-fleet supervisor) drive the same path with in-memory jobs.
+    """
+    from raft_tla_tpu.obs import RunTelemetry
+    from raft_tla_tpu.serve.batch import BatchExecutor
+    from raft_tla_tpu.serve.jobs import admit
+
+    os.makedirs(out_dir, exist_ok=True)
+
+    def say(msg: str) -> None:
+        if not quiet:
+            print(msg, flush=True)
+
+    # Admission first, for the whole intake — host-only, so a manifest
+    # full of junk costs zero device time and the rejects are reported
+    # before the first compile.
+    records: list[dict] = []
+    admitted = []
+    for job in jobs:
+        t_adm = time.monotonic()
+        adm = admit(job)
+        try:
+            digest = job.digest()
+        except (OSError, ValueError):
+            digest = None               # unreadable cfg: admission rejects
+        rec = {"job_id": job.job_id, "digest": digest,
+               "admission_s": round(time.monotonic() - t_adm, 3),
+               "events": _events_path(out_dir, job.job_id)}
+        if not adm.admitted:
+            rec.update(status="rejected", reason=adm.reason,
+                       findings=adm.findings_text())
+            _reject_events(rec["events"], job, adm.reason)
+            say(f"[{job.job_id}] rejected at admission ({adm.reason}); "
+                f"{len(adm.findings)} finding(s)")
+            records.append(rec)
+            continue
+        if adm.properties:
+            rec.update(status="rejected", reason="property-unsupported",
+                       findings=[f"PROPERTY {list(adm.properties)}: "
+                                 "liveness needs a dedicated exhaustive "
+                                 "run (raft-tla-check --property); the "
+                                 "batched service checks invariants only"])
+            _reject_events(rec["events"], job, "property-unsupported")
+            say(f"[{job.job_id}] rejected at admission "
+                "(property-unsupported)")
+            records.append(rec)
+            continue
+        admitted.append((job, adm, rec))
+        records.append(rec)
+
+    # One telemetry facade per tenant, each with its own explicit events
+    # path (never the RAFT_TLA_EVENTS fallback — that one env var would
+    # merge every lane into a single log).
+    telemetry = {}
+    for job, adm, rec in admitted:
+        telemetry[job.job_id] = RunTelemetry(
+            "serve", config=adm.config, events=rec["events"])
+
+    outcomes = {}
+    if admitted:
+        say(f"serving {len(admitted)} admitted job(s) "
+            f"({len(jobs) - len(admitted)} rejected) — chunk {chunk}")
+        ex = BatchExecutor(chunk=chunk, max_states=max_states)
+        outcomes = ex.run([(job.job_id, adm.config)
+                           for job, adm, rec in admitted],
+                          telemetry=telemetry)
+
+    for job, adm, rec in admitted:
+        oc = outcomes[job.job_id]
+        rec["status"] = oc.status
+        if oc.error:
+            rec["error"] = oc.error
+        if adm.findings:                 # admitted-with-warnings
+            rec["findings"] = adm.findings_text()
+        if oc.result is not None:
+            r = oc.result
+            rec.update(n_states=r.n_states, diameter=r.diameter,
+                       n_transitions=r.n_transitions,
+                       levels=list(r.levels),
+                       complete=bool(r.complete),
+                       wall_s=round(r.wall_s, 3),
+                       states_per_sec=round(r.states_per_sec, 1))
+            if r.violation is not None:
+                rec["violation"] = r.violation.invariant
+        say(f"[{job.job_id}] {rec['status']}: "
+            f"{rec.get('n_states', 0):,} states, "
+            f"diameter {rec.get('diameter', 0)}, "
+            f"{rec.get('wall_s', 0.0):.2f}s")
+
+    with open(os.path.join(out_dir, "results.jsonl"), "a",
+              encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    return records
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="raft-tla-serve",
+        description="Multi-tenant bounded-check service: admit N jobs "
+                    "through the speclint gate and pack them into shared "
+                    "batched device dispatches (lane-packed continuous "
+                    "batching), one event log per tenant.")
+    p.add_argument("source",
+                   help="job source: a JSONL manifest (one job object "
+                        "per line) or a queue directory of *.json job "
+                        "files; each job: {'id', 'cfg' | 'cfg_text', "
+                        "+ JobOptions fields (spec, max_term, ...)}")
+    p.add_argument("--out", default="serve-out", metavar="DIR",
+                   help="output directory: <id>.events per job + "
+                        "results.jsonl (default: serve-out)")
+    p.add_argument("--chunk", type=int, default=1024,
+                   help="shared dispatch width B — every bin compiles "
+                        "one [B, W] fused step and all of its lanes "
+                        "pack into it (default 1024)")
+    p.add_argument("--max-states", type=int, default=None,
+                   help="per-lane distinct-state cap; an exceeding lane "
+                        "is stopped (attributed in its event log), the "
+                        "other tenants keep running")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress per-job progress lines")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    if args.cpu:
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            if jax.default_backend() != "cpu":
+                print("Warning: --cpu requested but JAX backends are "
+                      f"already initialized on {jax.default_backend()!r}; "
+                      "proceeding there", file=sys.stderr)
+    try:
+        jobs = load_jobs(args.source)
+    except (OSError, ValueError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    records = run_service(jobs, args.out, chunk=args.chunk,
+                          max_states=args.max_states, quiet=args.quiet)
+    n_by = {}
+    for rec in records:
+        n_by[rec["status"]] = n_by.get(rec["status"], 0) + 1
+    if not args.quiet:
+        print("serve: " + ", ".join(f"{v} {k}"
+                                    for k, v in sorted(n_by.items()))
+              + f" -> {args.out}/results.jsonl")
+    return 1 if n_by.get("stopped") else 0
+
+
+def entry() -> None:
+    """Console-script entry point (pyproject ``raft-tla-serve``)."""
+    sys.exit(main())
+
+
+if __name__ == "__main__":
+    entry()

@@ -189,17 +189,29 @@ class Simulator:
 
     def run(self, n_behaviors: int,
             init_override: interp.PyState | None = None,
-            max_wall_s: float | None = None) -> SimResult:
+            max_wall_s: float | None = None,
+            on_progress=None, events: str | None = None) -> SimResult:
         t0 = time.monotonic()
+        # The same telemetry facade the exhaustive engines drive
+        # (obs/events.py): one segment record per device dispatch, a
+        # run_start/run_end envelope, and the --events JSONL log —
+        # replacing the simulator's pre-schema silence.  ``level`` carries
+        # the deepest walk seen (the closest analog to a BFS level).
+        from raft_tla_tpu.obs import RunTelemetry
+        tel = RunTelemetry("simulate", config=self.config,
+                           on_progress=on_progress, events=events, t0=t0)
         bounds = self.bounds
         init_py = init_override if init_override is not None \
             else interp.init_state(bounds)
         init_vec = interp.to_vec(init_py, bounds)
+        tel.run_start()
         for nm in self.config.invariants:
             if not inv_mod.py_invariant(nm)(init_py, bounds):
-                return SimResult(0, 1, 0,
-                                 Violation(nm, init_py, [(None, init_py)]),
-                                 time.monotonic() - t0)
+                res = SimResult(0, 1, 0,
+                                Violation(nm, init_py, [(None, init_py)]),
+                                time.monotonic() - t0)
+                self._end_telemetry(tel, res, complete=True)
+                return res
         iv = jnp.asarray(init_vec, I32)
 
         key = jax.random.PRNGKey(self.seed)
@@ -216,10 +228,15 @@ class Simulator:
              dead_w, fail) = self._segment(sub, iv, vecs, hist, hlen,
                                            n_beh, n_st, maxd)
             if bool(fail):
+                tel.stop_requested("tensor-encoding overflow",
+                                   source="simulate")
+                tel.close()
                 raise RuntimeError(
                     "simulation aborted: a sampled transition overflowed "
                     "the tensor encoding — bounds reasoning violated "
                     "(config.py capacity scheme)")
+            if tel.active:
+                tel.segment(int(n_st), int(maxd), int(n_st))
             vw, dw = int(viol_w), int(dead_w)
             if vw >= 0 or dw >= 0:
                 # If both landed in the same dispatch (different walkers),
@@ -230,19 +247,40 @@ class Simulator:
                     else DEADLOCK
                 trace = self._replay(init_py, np.asarray(hist[w]),
                                      int(hlen[w]))
-                return SimResult(
+                res = SimResult(
                     n_behaviors=int(n_beh), n_states=int(n_st),
                     max_depth_seen=int(maxd),
                     violation=Violation(name, trace[-1][1], trace),
                     wall_s=time.monotonic() - t0)
+                self._end_telemetry(tel, res, complete=True)
+                return res
             if int(n_beh) >= n_behaviors:
+                complete = True
                 break
             if max_wall_s is not None and \
                     time.monotonic() - t0 > max_wall_s:
+                complete = False    # wall-bounded partial run
                 break
-        return SimResult(n_behaviors=int(n_beh), n_states=int(n_st),
-                         max_depth_seen=int(maxd), violation=None,
-                         wall_s=time.monotonic() - t0)
+        res = SimResult(n_behaviors=int(n_beh), n_states=int(n_st),
+                        max_depth_seen=int(maxd), violation=None,
+                        wall_s=time.monotonic() - t0)
+        self._end_telemetry(tel, res, complete=complete)
+        return res
+
+    @staticmethod
+    def _end_telemetry(tel, res: SimResult, complete: bool) -> None:
+        """Adapt a :class:`SimResult` to the run_end contract (the facade
+        reads EngineResult field names; simulation has no BFS levels)."""
+        class _End:
+            n_states = res.n_states
+            n_transitions = res.n_states    # one transition per sampled state
+            violation = res.violation
+            diameter = res.max_depth_seen
+            levels: list = []
+            wall_s = res.wall_s
+        _End.complete = complete
+        tel.run_end(_End)
+        tel.close()
 
     def _replay(self, init_py, lanes: np.ndarray, hlen: int) -> list:
         """Rebuild the violating walk exactly through the interpreter."""

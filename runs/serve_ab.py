@@ -1,0 +1,159 @@
+"""Serve lane-packing A/B (ISSUE 6 acceptance gate).
+
+Claim under test: packing a 16-job toy-universe manifest into the
+lane-packed :class:`BatchExecutor` (a) leaves every lane's counts and
+verdict byte-identical to a solo ``engine.Engine`` run of the same cfg,
+and (b) delivers >= 80% of the summed solo aggregate throughput — the
+batch pays one jit compile per *bin* (4 bins here) where the solo arm
+pays one per *job* (16), and fills its shared chunk across tenants
+where each solo run pads its own.
+
+Protocol (the chip-state-fiducial discipline of RESULTS.md "sig-prune
+A/B"): arms interleave round-robin so machine drift hits both equally,
+and every rep carries a fiducial — a synthetic jitted step + 64 MB
+device copy timed immediately before the arm — so a drifted rep is
+visible in the artifact instead of silently biasing a mean.  Parity is
+asserted on EVERY rep, not sampled.
+
+Manifest: 16 jobs over 4 step-signature bins, all 2-server election
+universes (the 3,014-state toy x8, its Server-symmetry quotient x4,
+a max_term=3 widening x2, a max_msgs=3 widening x2).
+
+Usage: python runs/serve_ab.py [reps]   (default 3)
+Appends one JSON line per rep + a summary line to runs/serve_ab.out.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.engine import Engine
+from raft_tla_tpu.serve.batch import BatchExecutor, bin_key
+
+RUNS = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(RUNS, "serve_ab.out")
+
+CHUNK = 256                           # shared dispatch width, both arms
+
+
+def _cfg(**kw):
+    b = dict(n_servers=2, n_values=1, max_term=2, max_log=0, max_msgs=2)
+    sym = kw.pop("symmetry", ())
+    b.update(kw)
+    return CheckConfig(bounds=Bounds(**b), spec="election",
+                       invariants=("NoTwoLeaders",), symmetry=sym,
+                       chunk=CHUNK)
+
+
+TOY = _cfg()                          # 3,014 states, diameter 17
+TOY_SYM = _cfg(symmetry=("Server",))  # its symmetry quotient
+TOY_T3 = _cfg(max_term=3)             # term-widened universe
+TOY_M3 = _cfg(max_msgs=3)             # channel-widened universe
+
+JOBS = ([(f"toy-{i}", TOY) for i in range(8)]
+        + [(f"sym-{i}", TOY_SYM) for i in range(4)]
+        + [(f"t3-{i}", TOY_T3) for i in range(2)]
+        + [(f"m3-{i}", TOY_M3) for i in range(2)])
+
+
+def fiducial() -> dict:
+    """Synthetic step + copy, jitted and timed warm (chip/CPU weather)."""
+    x = jnp.arange(1 << 24, dtype=jnp.uint32)          # 64 MB
+
+    @jax.jit
+    def step(v):
+        return (v * jnp.uint32(2654435761) ^ (v >> 7)).sum()
+
+    step(x).block_until_ready()                        # compile
+    t0 = time.monotonic()
+    step(x).block_until_ready()
+    step_ms = (time.monotonic() - t0) * 1e3
+    t0 = time.monotonic()
+    jnp.array(x, copy=True).block_until_ready()
+    copy_ms = (time.monotonic() - t0) * 1e3
+    return {"synthetic_step_ms": round(step_ms, 2),
+            "copy_64mb_ms": round(copy_ms, 2)}
+
+
+def run_solo() -> tuple:
+    """The solo arm: 16 sequential Engine runs, one compile each (a new
+    closure per Engine — exactly what 16 separate submissions pay)."""
+    t0 = time.monotonic()
+    results = {jid: Engine(cfg).check() for jid, cfg in JOBS}
+    return time.monotonic() - t0, results
+
+
+def run_batch() -> tuple:
+    t0 = time.monotonic()
+    out = BatchExecutor(chunk=CHUNK).run(JOBS)
+    wall = time.monotonic() - t0
+    assert all(oc.status == "completed" for oc in out.values()), \
+        {j: oc.status for j, oc in out.items()}
+    return wall, {jid: oc.result for jid, oc in out.items()}
+
+
+def assert_parity(solo: dict, batch: dict) -> int:
+    total = 0
+    for jid, _cfg_ in JOBS:
+        a, b = solo[jid], batch[jid]
+        for field in ("n_states", "diameter", "n_transitions"):
+            assert getattr(a, field) == getattr(b, field), \
+                (jid, field, getattr(a, field), getattr(b, field))
+        assert list(a.levels) == list(b.levels), jid
+        assert dict(a.coverage) == dict(b.coverage), jid
+        assert a.complete and b.complete and a.violation is None \
+            and b.violation is None, jid
+        total += a.n_states
+    return total
+
+
+def main():
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    n_bins = len({bin_key(cfg) for _jid, cfg in JOBS})
+    walls: dict = {"solo": [], "batch": []}
+    n_total = None
+    with open(OUT, "a") as out:
+        for rep in range(reps):
+            for arm in ("solo", "batch"):   # interleaved: drift is shared
+                fid = fiducial()
+                wall, results = run_solo() if arm == "solo" \
+                    else run_batch()
+                walls[arm].append(wall)
+                if arm == "solo":
+                    solo_results = results
+                else:
+                    n_total = assert_parity(solo_results, results)
+                line = {"rep": rep, "arm": arm, "wall_s": round(wall, 2),
+                        "jobs": len(JOBS), "bins": n_bins,
+                        "platform": jax.default_backend(), **fid}
+                print(json.dumps(line))
+                out.write(json.dumps(line) + "\n")
+                out.flush()
+        med = {a: statistics.median(w) for a, w in walls.items()}
+        rate = {a: round(n_total / med[a], 1) for a in med}
+        summary = {
+            "summary": "serve_ab",
+            "jobs": len(JOBS), "bins": n_bins, "chunk": CHUNK,
+            "aggregate_states": n_total,
+            "reps": reps,
+            "parity": "byte-identical on every rep",
+            "median_wall_s": {a: round(m, 2) for a, m in med.items()},
+            "aggregate_states_per_sec": rate,
+            "batch_over_solo_rate": round(rate["batch"] / rate["solo"], 4),
+            "pass_ge_0.8": rate["batch"] / rate["solo"] >= 0.8,
+        }
+        print(json.dumps(summary))
+        out.write(json.dumps(summary) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -193,6 +193,55 @@ def test_event_log_round_trips(tmp_path):
     log.close()                                   # idempotent
 
 
+def test_concurrent_event_log_writers_do_not_corrupt(tmp_path):
+    """Serving-mode write pattern: two jobs' EventLogs appending to
+    separate logs concurrently, plus an external one-shot emitter
+    (``python -m raft_tla_tpu.obs emit``) interleaving whole lines into
+    one of them mid-run.  Every line must still parse and validate —
+    append-mode line-at-a-time writes never interleave partial lines."""
+    import threading
+
+    pa = str(tmp_path / "a.events")
+    pb = str(tmp_path / "b.events")
+    la, lb = EventLog(pa), EventLog(pb)
+    n_each = 400
+
+    def pump(log, tag):
+        for k in range(n_each):
+            log.emit("level_end", level=k, n_states=k * 10 + tag)
+
+    ta = threading.Thread(target=pump, args=(la, 1))
+    tb = threading.Thread(target=pump, args=(lb, 2))
+    ta.start(), tb.start()
+    # External one-shot emitters racing the live background writer on
+    # log A (the campaign_stop.sh pattern, now also the service's
+    # rejected-tenant path).
+    for k in range(3):
+        r = subprocess.run(
+            [sys.executable, "-m", "raft_tla_tpu.obs", "emit", pa,
+             "stop_requested", "--reason", f"external-{k}",
+             "--source", "test"],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+    ta.join(), tb.join()
+    la.close(), lb.close()
+
+    evs_a, evs_b = _read_log(pa), _read_log(pb)     # json.loads = no torn lines
+    for evs in (evs_a, evs_b):
+        assert all(validate_event(e) == [] for e in evs)
+    # nothing lost, nothing duplicated, no cross-log bleed
+    assert len(evs_a) == n_each + 3
+    assert len(evs_b) == n_each
+    lv_a = [e["level"] for e in evs_a if e["event"] == "level_end"]
+    assert sorted(lv_a) == list(range(n_each))
+    assert [e["n_states"] % 10 for e in evs_a
+            if e["event"] == "level_end"] == [1] * n_each
+    assert [e["n_states"] % 10 for e in evs_b] == [2] * n_each
+    exts = [e for e in evs_a if e["event"] == "stop_requested"]
+    assert sorted(e["reason"] for e in exts) == [
+        f"external-{k}" for k in range(3)]
+
+
 def test_phase_timers_disabled_is_inert_enabled_accumulates():
     off = PhaseTimers(enabled=False)
     with off.phase("expand") as ph:

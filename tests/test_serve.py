@@ -1,0 +1,350 @@
+"""Multi-tenant serve/: admission gate, lane-packed executor, service.
+
+Admission must reject width-unsafe and vacuous configs with speclint
+findings attached — before any device work.  The batch executor must
+produce per-lane counts byte-identical to solo ``engine.Engine`` runs
+(completing lanes) and identical verdicts/traces (violation/deadlock
+lanes).  The service front must leave one valid SCHEMA_VERSION=1 event
+log per tenant that the monitor renders unchanged.
+"""
+
+import json
+import os
+
+import pytest
+
+from test_cli import write_cfg
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.engine import DEADLOCK, Engine
+from raft_tla_tpu.models import interp, spec as S
+from raft_tla_tpu.ops import msgbits as mb
+from raft_tla_tpu.serve import CheckJob, JobOptions, admit
+from raft_tla_tpu.serve.batch import BatchExecutor, bin_key
+from raft_tla_tpu.serve.service import load_jobs, run_service
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLAGSHIP_CFG = os.path.join(REPO, "runs", "MC3s2v.cfg")
+
+# The 3014-state toy universe (known: diameter 17, 5274 transitions).
+TOY_BOUNDS = Bounds(n_servers=2, n_values=1, max_term=2, max_log=0,
+                    max_msgs=2)
+TOY = CheckConfig(bounds=TOY_BOUNDS, spec="election",
+                  invariants=("NoTwoLeaders",), chunk=256)
+
+_CONSTANTS = """CONSTANTS
+    Server = {%s}
+    Value = {v1}
+    Follower = "Follower"
+    Candidate = "Candidate"
+    Leader = "Leader"
+    Nil = "Nil"
+    RequestVoteRequest = "RequestVoteRequest"
+    RequestVoteResponse = "RequestVoteResponse"
+    AppendEntriesRequest = "AppendEntriesRequest"
+    AppendEntriesResponse = "AppendEntriesResponse"
+"""
+
+TOY_OPTS = JobOptions(spec="election", max_term=2, max_log=0, max_msgs=2)
+
+
+def _no_device(monkeypatch):
+    """Poison the step builder: admission must never reach the kernels."""
+    from raft_tla_tpu.ops import kernels
+
+    def boom(*a, **kw):                              # pragma: no cover
+        raise AssertionError("admission performed device work")
+    monkeypatch.setattr(kernels, "build_step", boom)
+
+
+# --------------------------------------------------------------------------
+# admission
+
+
+def test_admission_rejects_width_unsafe(tmp_path, monkeypatch):
+    _no_device(monkeypatch)
+    wide = write_cfg(tmp_path / "wide.cfg",
+                     servers=", ".join(f"s{i}" for i in range(1, 16)))
+    adm = admit(CheckJob("wide", TOY_OPTS, cfg_path=str(wide)))
+    assert not adm.admitted and adm.reason == "width-unsafe"
+    assert adm.config is None
+    codes = {f.code for f in adm.findings}
+    assert "bounds-invalid" in codes
+    assert adm.findings_text() and all(isinstance(t, str)
+                                       for t in adm.findings_text())
+
+
+def test_admission_rejects_vacuous(tmp_path, monkeypatch):
+    _no_device(monkeypatch)
+    # LogMatching under the log-free election subset checks nothing:
+    # a CLI warning, but the service must not bill device time for it.
+    text = ("SPECIFICATION Spec\nINVARIANT LogMatching\n"
+            + _CONSTANTS % "s1, s2")
+    adm = admit(CheckJob("vac", TOY_OPTS, cfg_text=text))
+    assert not adm.admitted and adm.reason == "vacuous"
+    assert any(f.code == "invariant-vacuous" for f in adm.findings)
+
+
+def test_admission_rejects_unreadable(tmp_path, monkeypatch):
+    _no_device(monkeypatch)
+    adm = admit(CheckJob("ghost", TOY_OPTS,
+                         cfg_path=str(tmp_path / "missing.cfg")))
+    assert not adm.admitted and adm.reason == "cfg-unreadable"
+
+
+def test_admission_rejects_unknown_invariant(monkeypatch):
+    _no_device(monkeypatch)
+    text = ("SPECIFICATION Spec\nINVARIANT NoTwoLeadres\n"
+            + _CONSTANTS % "s1, s2")
+    adm = admit(CheckJob("typo", TOY_OPTS, cfg_text=text))
+    assert not adm.admitted and adm.reason == "cfg-invalid"
+    assert any(f.severity == "error" for f in adm.findings)
+
+
+def test_admission_admits_flagship_cfg(monkeypatch):
+    _no_device(monkeypatch)
+    adm = admit(CheckJob("mc3s2v",
+                         JobOptions(spec="full", max_term=2, max_log=1),
+                         cfg_path=FLAGSHIP_CFG))
+    assert adm.admitted and adm.reason is None
+    cc = adm.config
+    assert cc.bounds.n_servers == 3 and cc.bounds.n_values == 2
+    assert cc.symmetry == ("Server",)
+    assert "NoTwoLeaders" in cc.invariants
+    assert adm.properties == ()
+
+
+def test_job_digest_covers_text_and_options(tmp_path):
+    toy = write_cfg(tmp_path / "toy.cfg")
+    by_path = CheckJob("a", TOY_OPTS, cfg_path=str(toy))
+    by_text = CheckJob("b", TOY_OPTS,
+                       cfg_text=(tmp_path / "toy.cfg").read_text())
+    # Same model: same digest regardless of id or path-vs-inline ...
+    assert by_path.digest() == by_text.digest()
+    # ... different options: different digest.
+    other = CheckJob("a", JobOptions(spec="election", max_term=3,
+                                     max_log=0, max_msgs=2),
+                     cfg_path=str(toy))
+    assert other.digest() != by_path.digest()
+
+
+def test_job_from_dict_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="unknown option"):
+        CheckJob.from_dict({"id": "x", "cfg_text": "", "max_trem": 3})
+    with pytest.raises(ValueError, match="no 'id'"):
+        CheckJob.from_dict({"cfg_text": ""})
+
+
+# --------------------------------------------------------------------------
+# lane-packed batch executor
+
+
+def bag(*ms):
+    return tuple(sorted((m, 1) for m in ms))
+
+
+VB = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0, max_msgs=4)
+VIOL = CheckConfig(bounds=VB, spec="election",
+                   invariants=("NaiveNoTwoLeaders",), chunk=256)
+DEAD = CheckConfig(bounds=Bounds(n_servers=1, n_values=1, max_term=2,
+                                 max_log=0, max_msgs=2),
+                   spec="election", invariants=(), check_deadlock=True,
+                   chunk=256)
+TOY_SYM = CheckConfig(bounds=TOY_BOUNDS, spec="election",
+                      invariants=("NoTwoLeaders",), symmetry=("Server",),
+                      chunk=256)
+
+
+def seeded_start():
+    """Two steps from a NaiveNoTwoLeaders violation (engine-test seed)."""
+    return interp.init_state(VB)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3), votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100), msgs=bag(mb.rv_response(3, 1, 1, 2)))
+
+
+def assert_counts_equal(res, ref):
+    assert res.n_states == ref.n_states
+    assert res.diameter == ref.diameter
+    assert res.n_transitions == ref.n_transitions
+    assert list(res.levels) == list(ref.levels)
+    assert dict(res.coverage) == dict(ref.coverage)
+    assert res.complete and ref.complete
+
+
+def test_bin_key_ignores_chunk():
+    rechunked = CheckConfig(bounds=TOY_BOUNDS, spec="election",
+                            invariants=("NoTwoLeaders",), chunk=64)
+    assert bin_key(TOY) == bin_key(rechunked)
+    assert bin_key(TOY) != bin_key(TOY_SYM)
+
+
+def test_batch_lanes_match_solo_runs():
+    """One executor, four bins (toy x2 shares one): every completing
+    lane's counts byte-identical to a solo Engine of the same cfg, and
+    violation/deadlock lanes reach the solo verdict and trace."""
+    ex = BatchExecutor(chunk=256)
+    out = ex.run([("toy-a", TOY), ("toy-b", TOY), ("sym", TOY_SYM),
+                  ("dead", DEAD), ("viol", VIOL)],
+                 init_overrides={"viol": seeded_start()})
+    assert set(out) == {"toy-a", "toy-b", "sym", "dead", "viol"}
+
+    solo_toy = Engine(TOY).check()
+    assert solo_toy.n_states == 3014 and solo_toy.n_transitions == 5274
+    for jid in ("toy-a", "toy-b"):
+        assert out[jid].status == "completed"
+        assert_counts_equal(out[jid].result, solo_toy)
+
+    solo_sym = Engine(TOY_SYM).check()
+    assert out["sym"].status == "completed"
+    assert_counts_equal(out["sym"].result, solo_sym)
+    assert solo_sym.n_states < solo_toy.n_states     # symmetry quotient
+
+    solo_dead = Engine(DEAD).check()
+    assert out["dead"].status == "deadlock"
+    v = out["dead"].result.violation
+    assert v.invariant == DEADLOCK == solo_dead.violation.invariant
+    assert v.trace == solo_dead.violation.trace
+
+    solo_viol = Engine(VIOL).check(init_override=seeded_start())
+    assert out["viol"].status == "violation"
+    v = out["viol"].result.violation
+    assert v.invariant == "NaiveNoTwoLeaders"
+    assert v.trace == solo_viol.violation.trace
+    assert v.state == solo_viol.violation.state
+
+
+def test_batch_duplicate_job_id_rejected():
+    with pytest.raises(ValueError, match="duplicate job id"):
+        BatchExecutor(chunk=64).run([("a", TOY), ("a", TOY)])
+
+
+def test_batch_max_states_stops_one_lane_only():
+    """A lane blowing its cap is stopped with attribution; its bin-mates
+    (and other bins) keep running to their verdicts."""
+    out = BatchExecutor(chunk=128, max_states=200).run(
+        [("big", TOY), ("dead", DEAD)])
+    assert out["big"].status == "stopped"
+    assert "exceeded 200" in out["big"].error
+    assert not out["big"].result.complete
+    assert out["dead"].status == "deadlock"
+
+
+# --------------------------------------------------------------------------
+# service front
+
+
+def _toy_manifest_line(jid, **extra):
+    d = {"id": jid, "cfg": "toy.cfg", "spec": "election", "max_term": 2,
+         "max_log": 0, "max_msgs": 2}
+    d.update(extra)
+    return json.dumps(d)
+
+
+def _write_service_inputs(tmp_path):
+    write_cfg(tmp_path / "toy.cfg")
+    write_cfg(tmp_path / "wide.cfg",
+              servers=", ".join(f"s{i}" for i in range(1, 16)))
+    return tmp_path / "manifest.jsonl"
+
+
+@pytest.mark.smoke
+def test_service_end_to_end(tmp_path):
+    from raft_tla_tpu.obs import validate_event
+    from raft_tla_tpu.obs import monitor
+
+    manifest = _write_service_inputs(tmp_path)
+    vac_text = ("SPECIFICATION Spec\nINVARIANT LogMatching\n"
+                + _CONSTANTS % "s1, s2")
+    manifest.write_text("\n".join([
+        "# comment lines and blanks are skipped",
+        "",
+        _toy_manifest_line("good-a"),
+        _toy_manifest_line("good-b"),
+        _toy_manifest_line("wide", cfg="wide.cfg"),
+        json.dumps({"id": "vac", "cfg_text": vac_text, "spec": "election",
+                    "max_term": 2, "max_log": 0, "max_msgs": 2}),
+        _toy_manifest_line("live", properties=["EventuallyLeader"]),
+    ]) + "\n")
+
+    out_dir = tmp_path / "out"
+    records = run_service(load_jobs(str(manifest)), str(out_dir),
+                          chunk=256, quiet=True)
+    by_id = {r["job_id"]: r for r in records}
+    assert set(by_id) == {"good-a", "good-b", "wide", "vac", "live"}
+
+    # Verdicts + tenant isolation: identical jobs share a digest, the
+    # results file is the same records the call returned.
+    assert by_id["good-a"]["status"] == "completed"
+    assert by_id["good-a"]["n_states"] == 3014
+    assert by_id["good-a"]["digest"] == by_id["good-b"]["digest"]
+    assert by_id["wide"]["status"] == "rejected"
+    assert by_id["wide"]["reason"] == "width-unsafe"
+    assert by_id["wide"]["findings"]            # lint payload attached
+    assert by_id["vac"]["reason"] == "vacuous"
+    assert by_id["live"]["reason"] == "property-unsupported"
+    on_disk = [json.loads(l)
+               for l in (out_dir / "results.jsonl").read_text().splitlines()]
+    assert {r["job_id"] for r in on_disk} == set(by_id)
+
+    # One conformant event log per tenant; the monitor renders each with
+    # the right end-state attribution, no serve-specific handling.
+    for jid, want in [("good-a", "ok"), ("good-b", "ok"),
+                      ("wide", "rejected"), ("vac", "rejected"),
+                      ("live", "rejected")]:
+        path = by_id[jid]["events"]
+        events = [json.loads(l) for l in open(path)]
+        assert not [e for d in events for e in validate_event(d)], jid
+        assert events[0]["event"] == "run_start"
+        assert events[-1]["event"] == "run_end"
+        hb = monitor.heartbeat(monitor.summarize(monitor.load_stream(path)))
+        assert want in hb, (jid, hb)
+
+
+def test_service_stopped_lane_attribution(tmp_path):
+    from raft_tla_tpu.obs import monitor
+
+    manifest = _write_service_inputs(tmp_path)
+    manifest.write_text(_toy_manifest_line("capped") + "\n")
+    records = run_service(load_jobs(str(manifest)), str(tmp_path / "out"),
+                          chunk=128, max_states=200, quiet=True)
+    (rec,) = records
+    assert rec["status"] == "stopped" and "exceeded 200" in rec["error"]
+    hb = monitor.heartbeat(monitor.summarize(
+        monitor.load_stream(rec["events"])))
+    assert "stopped" in hb, hb
+
+
+def test_load_jobs_queue_dir_and_errors(tmp_path):
+    write_cfg(tmp_path / "toy.cfg")
+    qdir = tmp_path / "queue"
+    qdir.mkdir()
+    # Queue convention: filename stem is the default id, sorted order.
+    (qdir / "010-beta.json").write_text(json.dumps(
+        {"cfg": str(tmp_path / "toy.cfg"), "spec": "election"}))
+    (qdir / "005-alpha.json").write_text(json.dumps(
+        {"cfg": "toy.cfg", "spec": "election"}))
+    (qdir / "toy.cfg").write_text((tmp_path / "toy.cfg").read_text())
+    jobs = load_jobs(str(qdir))
+    assert [j.job_id for j in jobs] == ["005-alpha", "010-beta"]
+    # Relative cfg resolved against the queue dir itself.
+    assert jobs[0].cfg_path == str(qdir / "toy.cfg")
+
+    m = tmp_path / "bad.jsonl"
+    m.write_text(_toy_manifest_line("a") + "\n" + _toy_manifest_line("a")
+                 + "\n")
+    with pytest.raises(ValueError, match="duplicate job id"):
+        load_jobs(str(m))
+    m.write_text(_toy_manifest_line("../evil") + "\n")
+    with pytest.raises(ValueError, match="not path-safe"):
+        load_jobs(str(m))
+    m.write_text(_toy_manifest_line("a", max_trem=3) + "\n")
+    with pytest.raises(ValueError, match="unknown option"):
+        load_jobs(str(m))
+    m.write_text("{not json\n")
+    with pytest.raises(ValueError, match="not JSON"):
+        load_jobs(str(m))
+    empty = tmp_path / "empty-queue"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="no \\*.json jobs"):
+        load_jobs(str(empty))

@@ -119,6 +119,29 @@ def test_simulation_composes_with_faithful_mode():
     assert r.violation is None and r.n_behaviors >= 300
 
 
+def test_simulation_emits_event_log(tmp_path):
+    """simulate.py speaks RunTelemetry: a conformant SCHEMA_VERSION=1
+    log with per-dispatch segments and an outcome-attributed run_end."""
+    import json
+
+    from raft_tla_tpu.obs import validate_event
+
+    path = str(tmp_path / "sim.events")
+    cc = CheckConfig(bounds=Bounds(n_servers=2, n_values=1, max_term=2,
+                                   max_log=1, max_msgs=2),
+                     spec="full", invariants=("NoTwoLeaders",))
+    r = Simulator(cc, walkers=128, depth=40, steps_per_dispatch=32,
+                  seed=1).run(500, events=path)
+    assert r.violation is None
+    events = [json.loads(l) for l in open(path)]
+    assert not [e for d in events for e in validate_event(d)]
+    assert events[0]["event"] == "run_start"
+    assert events[0]["engine"] == "simulate"
+    assert sum(1 for d in events if d["event"] == "segment") >= 1
+    assert events[-1]["event"] == "run_end"
+    assert events[-1]["outcome"] == "ok" and events[-1]["complete"]
+
+
 def test_cli_simulate_rejects_properties(tmp_path):
     from test_cli import run_cli, write_cfg
     from raft_tla_tpu import check as cli

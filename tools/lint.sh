@@ -22,3 +22,42 @@ python -m pytest tests/ -m smoke --collect-only -q -p no:cacheprovider \
 
 echo "== obs smoke (event schema conformance) =="
 python -m pytest tests/test_obs.py -m smoke -q -p no:cacheprovider | tail -2
+
+echo "== serve smoke (2-job toy manifest end-to-end, CPU) =="
+SERVE_TMP="$(mktemp -d)"
+trap 'rm -rf "$SERVE_TMP"' EXIT
+cat > "$SERVE_TMP/toy.cfg" <<'CFG'
+SPECIFICATION Spec
+INVARIANT NoTwoLeaders
+CONSTANTS
+    Server = {s1, s2}
+    Value = {v1}
+    Follower = "Follower"
+    Candidate = "Candidate"
+    Leader = "Leader"
+    Nil = "Nil"
+    RequestVoteRequest = "RequestVoteRequest"
+    RequestVoteResponse = "RequestVoteResponse"
+    AppendEntriesRequest = "AppendEntriesRequest"
+    AppendEntriesResponse = "AppendEntriesResponse"
+CFG
+cat > "$SERVE_TMP/manifest.jsonl" <<'MANIFEST'
+{"id": "smoke-a", "cfg": "toy.cfg", "spec": "election", "max_term": 2, "max_log": 0, "max_msgs": 2}
+{"id": "smoke-b", "cfg": "toy.cfg", "spec": "election", "max_term": 2, "max_log": 0, "max_msgs": 2}
+MANIFEST
+python -m raft_tla_tpu.serve "$SERVE_TMP/manifest.jsonl" \
+    --out "$SERVE_TMP/out" --chunk 256 --cpu --quiet
+python - "$SERVE_TMP/out" <<'PY'
+import json, sys
+out = sys.argv[1]
+recs = [json.loads(l) for l in open(f"{out}/results.jsonl")]
+assert len(recs) == 2 and all(r["status"] == "completed" for r in recs), recs
+assert all(r["n_states"] == 3014 for r in recs), recs
+from raft_tla_tpu.obs import validate_event
+for r in recs:
+    events = [json.loads(l) for l in open(r["events"])]
+    assert not [e for d in events for e in validate_event(d)]
+    assert events[-1]["event"] == "run_end" and events[-1]["outcome"] == "ok"
+print(f"serve smoke ok: 2 jobs x {recs[0]['n_states']} states, "
+      "per-tenant event logs valid")
+PY
