@@ -113,9 +113,10 @@ def run_fiducial() -> None:
     """
     import math
 
-    # pin the orbit-scan program: policy changes must not move the fiducial
+    # pin the step program: policy changes must not move the fiducial
     os.environ["RAFT_TLA_PRESCAN"] = "off"
     os.environ["RAFT_TLA_SIGPRUNE"] = "off"
+    os.environ["RAFT_TLA_MEGAKERNEL"] = "off"
 
     import jax
     import jax.numpy as jnp
@@ -190,6 +191,68 @@ def run_fiducial() -> None:
         "pct_vpu_peak": round(100.0 * words_per_sec / peak_words_per_sec,
                               2),
     }))
+
+
+def run_megakernel_probe() -> None:
+    """Child process: both step builds at the fiducial shape.
+
+    The pinned synthetic step (run_fiducial) measured twice — XLA build
+    vs the Pallas megakernel build (ops/pallas_step.py), identical rows,
+    orbit-scan gates forced off both times so the only difference is the
+    dispatch path.  Emits ``megakernel_step_ms`` next to the XLA
+    ``synthetic_step_ms`` twin so every fiducial-carrying bench round
+    captures both paths (the megakernel A/B protocol, RESULTS.md
+    "Megakernel A/B").  On CPU the megakernel runs under the Pallas
+    interpreter — the honest number for the path a CPU run would take,
+    not a TPU projection.  This pinned-gate ratio is a DRIFT TRACKER,
+    not the policy decider: with gates pinned off the block-sliced
+    program can show a win (1.13x on the container CPU) that the
+    production auto-policy program inverts — the deciding comparison is
+    runs/megakernel_ab.py's auto-policy arms + in-engine probe.
+    """
+    os.environ["RAFT_TLA_PRESCAN"] = "off"
+    os.environ["RAFT_TLA_SIGPRUNE"] = "off"
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tla_tpu.config import Bounds
+    from raft_tla_tpu.models import interp
+    from raft_tla_tpu.ops import kernels
+
+    def _median_ms(fn, reps=5):
+        times = []
+        for _ in range(reps):
+            t0 = time.monotonic()
+            jax.block_until_ready(fn())
+            times.append(time.monotonic() - t0)
+        return sorted(times)[len(times) // 2] * 1e3
+
+    bounds = Bounds(n_servers=3, n_values=2, max_term=2, max_log=1,
+                    max_msgs=2, max_dup=1)
+    chunk, spec = 4096, "full"
+    pool, frontier, seen = [], [interp.init_state(bounds)], set()
+    for _ in range(2):
+        nxt = []
+        for s in frontier:
+            for _i, t in interp.successors(s, bounds, spec=spec):
+                if t not in seen and interp.constraint_ok(t, bounds):
+                    seen.add(t)
+                    nxt.append(t)
+        frontier = nxt
+        pool += nxt
+    rows = np.stack([interp.to_vec(s, bounds) for s in pool])
+    vecs = jnp.asarray(np.tile(rows, (-(-chunk // len(rows)), 1))[:chunk])
+    args = (bounds, spec, ("NoTwoLeaders", "LogMatching"), ("Server",))
+    out = {}
+    for name, mega in (("xla_step_ms", False), ("megakernel_step_ms", True)):
+        step = jax.jit(kernels.build_step(*args, megakernel=mega))
+        jax.block_until_ready(step(vecs))                # compile
+        out[name] = round(_median_ms(lambda: step(vecs)), 2)
+    out["megakernel_vs_xla"] = round(out["xla_step_ms"] /
+                                     max(out["megakernel_step_ms"], 1e-9), 3)
+    print(json.dumps(out))
 
 
 def run_northstar() -> None:
@@ -307,6 +370,29 @@ def main() -> None:
           f"{fid['words_per_sec']:,.0f} orbit-words/s "
           f"({fid['pct_vpu_peak']:.1f}% of measured VPU ceiling)",
           file=sys.stderr)
+    # -- part 0.6: megakernel probe column ---------------------------------
+    # both step builds at the fiducial shape (RESULTS.md "Megakernel
+    # A/B").  Optional evidence: a probe failure — e.g. Mosaic refusing
+    # the staged kernel on some future chip — becomes a recorded error
+    # column, never the round's verdict.
+    try:
+        proc = subprocess.run([sys.executable, __file__, "--megakernel"],
+                              capture_output=True, text=True, timeout=900)
+        if proc.returncode == 0:
+            mk = json.loads(proc.stdout.strip().splitlines()[-1])
+            print(f"megakernel probe: xla {mk['xla_step_ms']:.1f} ms vs "
+                  f"megakernel {mk['megakernel_step_ms']:.1f} ms "
+                  f"({mk['megakernel_vs_xla']:.2f}x)", file=sys.stderr)
+        else:
+            sys.stderr.write(proc.stderr[-2000:])
+            mk = {"megakernel_probe_error": f"rc={proc.returncode}"}
+    except subprocess.TimeoutExpired:
+        mk = {"megakernel_probe_error": "timeout"}
+    except (ValueError, IndexError, KeyError):
+        mk = {"megakernel_probe_error": "unparseable"}
+    fid.update(mk)
+    _partial.update(mk)
+
     events_path = os.environ.get("RAFT_TLA_EVENTS")
     if events_path:
         # chip-weather evidence into the campaign's event log: the
@@ -383,5 +469,7 @@ if __name__ == "__main__":
         run_northstar()
     elif len(sys.argv) == 2 and sys.argv[1] == "--fiducial":
         run_fiducial()
+    elif len(sys.argv) == 2 and sys.argv[1] == "--megakernel":
+        run_megakernel_probe()
     else:
         main()

@@ -204,6 +204,17 @@ def build_argparser() -> argparse.ArgumentParser:
                         "every engine inherits one decision; default: "
                         "leave the env/auto policy alone (auto is "
                         "currently OFF — RESULTS.md 'sig-prune A/B')")
+    p.add_argument("--megakernel", default=None,
+                   choices=("auto", "on", "off"),
+                   help="Pallas megakernel build of the fused step: the "
+                        "whole expand/canonicalize/orbit/filter pipeline "
+                        "in ONE kernel with candidates VMEM-resident "
+                        "across stages (ops/pallas_step.py; bit-identical "
+                        "lane for lane). Sets RAFT_TLA_MEGAKERNEL "
+                        "process-wide so every engine inherits one "
+                        "decision; default: leave the env/auto policy "
+                        "alone (auto is currently OFF — RESULTS.md "
+                        "'Megakernel A/B')")
     p.add_argument("--lint", default="warn", choices=("warn", "strict"),
                    help="static width-safety pass (analysis/widthcheck) "
                         "before any step build: prove no transition can "
@@ -466,6 +477,11 @@ def main(argv=None) -> int:
         # re-runs build engines of their own.
         import os
         os.environ["RAFT_TLA_SIGPRUNE"] = args.sig_prune
+    if args.megakernel is not None:
+        # Same contract as --sig-prune: resolved at step-construction
+        # time (ops/kernels._megakernel_enabled) by every engine family.
+        import os
+        os.environ["RAFT_TLA_MEGAKERNEL"] = args.megakernel
     _DEVICE_ENGINES = ("device", "paged", "streamed", "ddd", "shard",
                        "pagedshard", "ddd-shard")
     if args.view and args.simulate:
@@ -482,6 +498,11 @@ def main(argv=None) -> int:
                 "the routed step is not built for other engines — "
                 "dropping it silently would run a different program "
                 "than configured")
+    if args.route and args.megakernel == "on":
+        p.error("--megakernel on does not compose with --route (the "
+                "routed step's lane compaction is an XLA scatter between "
+                "the megakernel's fused phases); use --route 0 or leave "
+                "the megakernel gate auto/off")
     if (args.checkpoint or args.resume) and \
             args.engine not in _DEVICE_ENGINES:
         p.error(f"--checkpoint/--resume require a device-class engine "

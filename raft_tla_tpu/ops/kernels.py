@@ -608,6 +608,10 @@ def _step_stages(bounds: Bounds, spec: str, invariants: tuple,
     # permute/canonicalize/pack/fingerprint pipeline resident, which is
     # all the kernel could offer.  Mosaic findings preserved in
     # RESULTS.md "Pallas orbit kernel" and runs/pallas_orbit_p24.out.
+    # (Distinct bet, different scope: the WHOLE-step Pallas megakernel,
+    # ops/pallas_step.py, stages this very program into one kernel to
+    # eliminate the HBM round-trips BETWEEN the stage fusions — gated
+    # RAFT_TLA_MEGAKERNEL, auto=OFF; see _megakernel_enabled.)
     # The view folds into the DEDUP KEY only: stored rows, invariants and
     # the constraint all see the full successor (TLC VIEW semantics).
     viewer = None
@@ -618,7 +622,8 @@ def _step_stages(bounds: Bounds, spec: str, invariants: tuple,
 
 
 def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
-               symmetry: tuple = (), view: str | None = None):
+               symmetry: tuple = (), view: str | None = None,
+               megakernel: bool | None = None):
     """One fused frontier step: packed vecs -> everything the engine needs.
 
     ``step(vecs[B, W]) -> dict`` with packed successors ``svecs [B, A, W]``,
@@ -632,7 +637,22 @@ def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
     orbit-minimal fingerprint over all server permutations
     (ops/symmetry.py) — the dedup key that quotients the state space the
     way TLC's SYMMETRY stanza does.
+
+    ``megakernel`` selects the Pallas megakernel build of the SAME step
+    (ops/pallas_step.py: one kernel, candidates VMEM-resident across all
+    stages, bit-identical lane for lane); ``None`` defers to the
+    ``RAFT_TLA_MEGAKERNEL`` gate (:func:`_megakernel_enabled`) so every
+    engine family inherits one process-wide decision at construction
+    time, exactly like sig-prune.  The compile signature — everything
+    this builder specializes on, gates included — is
+    :func:`step_signature`; keep serving-side bin keys on that helper.
     """
+    if megakernel is None:
+        megakernel = _megakernel_enabled(bounds, symmetry)
+    if megakernel:
+        from raft_tla_tpu.ops import pallas_step
+        return pallas_step.build_step_megakernel(
+            bounds, spec, invariants, symmetry, view)
     stages = _step_stages(bounds, spec, invariants, symmetry, view)
     lay = stages[0]
     expand = stages[2]
@@ -724,6 +744,57 @@ def _sigprune_enabled(bounds, symmetry):
     if force == "off":           # workloads — not the default
         return False
     return False
+
+
+def _megakernel_enabled(bounds, symmetry):
+    """Platform gate for the Pallas megakernel build of the fused step
+    (ops/pallas_step.py: the whole expand->canonicalize->orbit->filter
+    pipeline in ONE kernel, candidates VMEM-resident across stages).
+    Env override ``RAFT_TLA_MEGAKERNEL`` {auto, on, off} mirrors
+    RAFT_TLA_SIGPRUNE; ``check.py --megakernel`` sets it process-wide so
+    every engine inherits one decision at step-construction time.
+
+    Auto policy: OFF.  Measured on CPU (runs/megakernel_ab.py: sync-timed
+    per-chunk medians, in-engine northstar probe with per-phase
+    attribution, chip-state fiducials bracketing): in-engine the gate-on
+    arm is a 0.82x warm-rate LOSS (7,384 vs 9,006 orbits/s; the whole
+    delta is the expand phase, 135.4 s vs 112.6 s) even though the
+    block-sliced program wins 2-5% on pinned-gate step timings — under
+    the production auto policy the prescan ladder makes the XLA step
+    >2x faster, and the staged ladder is BLOCK-LOCAL (its signature
+    grouping sees one 128-row block instead of the whole chunk), so the
+    blocking that helps the pinned program costs the production one
+    (RESULTS.md "Megakernel A/B" attributes the loss entirely to the
+    expand phase).  On-chip
+    the bet is HBM-round-trip elimination between the stage fusions vs
+    Mosaic's appetite for the gather/sort-heavy canonicalize+prescan
+    stages (the round-2 hand orbit kernel died there — RESULTS.md
+    "Pallas orbit kernel"); the on-chip A/B is queued, and the gate
+    stays available for it via the override."""
+    import os
+    force = os.environ.get("RAFT_TLA_MEGAKERNEL", "auto")
+    if force == "on":            # measurement override (runs/megakernel_ab)
+        return True              # and the on-chip re-A/B — not the default
+    if force == "off":
+        return False
+    return False
+
+
+def step_signature(bounds, spec, invariants, symmetry, view):
+    """Everything :func:`build_step` specializes the compiled step on —
+    universe bounds, spec subset, invariant set, symmetry axes, the
+    dedup-key view, and the construction-time gate resolutions
+    (megakernel / prescan / sig-prune).  THE definition of step-compile
+    identity: serve/batch.bin_key delegates here, so two jobs share a
+    lane-packed bin (and a compile) iff this tuple matches — bins can
+    never mix step variants when a gate flips between admissions.
+
+    Gates resolve per call (env + backend), so compute the signature at
+    the same time you build the step it stands for."""
+    return (bounds, spec, tuple(invariants), tuple(symmetry), view,
+            ("megakernel", _megakernel_enabled(bounds, symmetry)),
+            ("prescan", _prescan_enabled(bounds, symmetry)),
+            ("sigprune", _sigprune_enabled(bounds, symmetry)))
 
 
 def _orbit_fp_prescan(orbit_fp, flat, raw_hi, raw_lo, N):
@@ -820,7 +891,8 @@ def apply_stages(bounds, stages, symmetry, succs, svecs, valid):
 
 def build_step_routed(bounds: Bounds, spec: str = "full",
                       invariants: tuple = (), symmetry: tuple = (),
-                      k_rows: int = 0, view: str | None = None):
+                      k_rows: int = 0, view: str | None = None,
+                      megakernel: bool | None = None):
     """EP-style routed frontier step (SURVEY §2.9, EP row): compact the
     enabled lanes, then run the expensive per-candidate stages densely.
 
@@ -860,6 +932,18 @@ def build_step_routed(bounds: Bounds, spec: str = "full",
     default.  Correct for parity AND faithful mode (the expansion twin
     carries the allLogs update; history fields ride the same gather).
     """
+    if megakernel is None:
+        megakernel = _megakernel_enabled(bounds, symmetry)
+    if megakernel:
+        # The routed step's stable-order compaction is an XLA scatter
+        # BETWEEN the expand and stage phases — there is no fused-kernel
+        # build of it.  Refusing loudly at construction beats silently
+        # ignoring the gate (check.py rejects --megakernel on + --route
+        # up front; direct env users land here).
+        raise ValueError(
+            "RAFT_TLA_MEGAKERNEL=on does not compose with the EP-routed "
+            "step (build_step_routed); use the dense step (--route 0) or "
+            "leave the megakernel gate auto/off")
     (lay, consts, expand, inv_fns, orbit_fp,
      viewer) = _step_stages(bounds, spec, invariants, symmetry, view)
     if k_rows <= 0:

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tla_tpu.ops import fingerprint as fpr
+from raft_tla_tpu.ops import pallas_compat as pc
 
 _BLOCK_ROWS = 1024
 _LANES = 128          # TPU lane width; W pads up to a multiple
@@ -112,18 +113,20 @@ def _padded_constants(W: int, Wp: int):
     return c1, c2
 
 
-def fingerprint_rows(vecs, interpret: bool = False):
+def fingerprint_rows(vecs, interpret: bool | None = None):
     """``int32[B, W] -> (hi, lo) uint32[B]`` via the Pallas kernel.
 
     Rows pad to the block multiple and lanes to 128 (zero pads contribute
     zero to the multilinear sum, so padding never changes a fingerprint).
-    ``interpret=True`` runs the kernel in Pallas interpret mode (CPU
-    testing); otherwise requires a TPU backend — use
-    ``ops.fingerprint.fingerprint`` for the portable path.
+    Execution mode is resolved by ``ops.pallas_compat``: ``interpret=True``
+    runs the kernel under the Pallas interpreter (CPU testing), ``None``
+    auto-selects — Mosaic on TPU, else the bit-identical portable jnp
+    path (``ops.fingerprint.fingerprint``) — and ``False`` forces a real
+    Mosaic build (loud failure off-TPU).
     """
     vecs = jnp.asarray(vecs, jnp.int32)
     B, W = vecs.shape
-    if not interpret and jax.default_backend() != "tpu":
+    if pc.resolve(interpret, jnp_fallback=True) == pc.JNP:
         # the portable jnp path (XLA-fused; bit-identical by construction)
         return fpr.fingerprint(vecs, jnp.asarray(fpr.lane_constants(W)),
                                jnp)
@@ -131,5 +134,7 @@ def fingerprint_rows(vecs, interpret: bool = False):
     Bp = ((B + _BLOCK_ROWS - 1) // _BLOCK_ROWS) * _BLOCK_ROWS
     vp = jnp.zeros((Bp, Wp), jnp.int32).at[:B, :W].set(vecs)
     c1, c2 = _padded_constants(W, Wp)
-    hi, lo = _fp_call(vp, c1, c2, interpret=interpret)
+    hi, lo = _fp_call(vp, c1, c2,
+                      interpret=pc.resolve(interpret,
+                                           jnp_fallback=True) == pc.INTERPRET)
     return hi[:B].astype(jnp.uint32), lo[:B].astype(jnp.uint32)

@@ -50,12 +50,22 @@ from raft_tla_tpu.ops import symmetry as sym_mod
 def bin_key(config: CheckConfig) -> tuple:
     """The step-signature bin: everything ``build_step`` compiles over.
 
+    Delegates to ``ops/kernels.step_signature`` — THE definition of
+    step-compile identity, including the construction-time gate
+    resolutions (megakernel / prescan / sig-prune) — so a gate flipping
+    between admissions can never mix step variants inside one bin.
+    (Previously this tuple was hand-maintained here, so a new
+    step-compile toggle had to be remembered in two places.)
+
     ``chunk`` is deliberately excluded — the executor imposes its own
     shared chunk shape, so jobs differing only in requested chunk share
-    a bin (and a compile).
+    a bin (and a compile).  ``check_deadlock`` is appended even though
+    the step does not compile over it: the executor's per-lane scan
+    logic branches on it, and bins share that scan path.
     """
-    return (config.bounds, config.spec, tuple(config.invariants),
-            tuple(config.symmetry), config.view, config.check_deadlock)
+    return kernels.step_signature(
+        config.bounds, config.spec, tuple(config.invariants),
+        tuple(config.symmetry), config.view) + (config.check_deadlock,)
 
 
 class _LaneFailure(Exception):

@@ -1,0 +1,232 @@
+"""Sync-timed A/B of the Pallas whole-step megakernel
+(ops/pallas_step.build_step_megakernel) — decides the
+_megakernel_enabled auto policy.  The megakernel stages the EXACT
+fused-step program (jax.make_jaxpr over ops/kernels.build_step at one
+row-block shape) into a single pallas_call over 128-row VMEM-resident
+blocks, so the candidate tensor round-trips to HBM once per step
+instead of once per XLA fusion boundary.  Parity is by construction
+(same jaxpr, re-evaluated per block) and asserted bit-for-bit anyway.
+
+Three measurements, all under the r3/r4 protocol (block_until_ready
+between reps, median of reps, chip-state fiducials via
+``bench.py --fiducial`` bracketing the session so drift is visible in
+the artifact instead of silently biasing a mean):
+
+- step-level at the flagship shape (|G| = 6), ``mid`` and ``shallow``
+  pools, under BOTH gate policies: ``pinned`` (prescan + sig-prune
+  forced off — the bit-stable fiducial program) and ``auto`` (the
+  program production actually builds on this backend);
+- step-level at elect5 (|G| = 120) under ``auto`` only — the orbit
+  scan dominates there and the staged program is what ships;
+- in-engine: the bench.py northstar probe (DDD engine, flagship
+  shape, chunk 4096) per arm with RAFT_TLA_MEGAKERNEL off vs on and
+  RAFT_TLA_PHASE_TIMERS=1, comparing warm orbits/sec with per-phase
+  attribution (upload/expand/export/dedup/snapshot) and asserting
+  n_states prefix parity across every segment both arms completed.
+
+``pct_vpu_peak`` headroom comes from the bracketing fiducials (the
+measured elementwise ceiling, so the ratio cancels chip weather).
+
+Usage: python runs/megakernel_ab.py [--cpu] [reps] [chunk]
+Artifact: runs/megakernel_ab.out (RESULTS.md "Megakernel A/B").
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+if "--cpu" in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.models import interp
+from raft_tla_tpu.ops import kernels
+
+_ints = [int(a) for a in sys.argv[1:] if a.isdigit()]
+REPS = _ints[0] if _ints else 5
+B = _ints[1] if len(_ints) > 1 else 1024
+DEADLINE_S = 150.0                 # per in-engine arm (northstar-style)
+
+FLAGSHIP = (Bounds(n_servers=3, n_values=2, max_term=2, max_log=1,
+                   max_msgs=2, max_dup=1),
+            "full", ("NoTwoLeaders", "LogMatching",
+                     "CommittedWithinLog", "LeaderCompleteness"))
+ELECT5 = (Bounds(n_servers=5, n_values=2, max_term=2, max_log=0,
+                 max_msgs=2, max_dup=1),
+          "election", ("NoTwoLeaders", "CommittedWithinLog"))
+
+_GATES = ("RAFT_TLA_PRESCAN", "RAFT_TLA_SIGPRUNE", "RAFT_TLA_MEGAKERNEL")
+
+
+def _fiducial():
+    """bench.py --fiducial in a child (fresh jit caches, pinned gates)."""
+    bench = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    try:
+        out = subprocess.run(
+            [sys.executable, bench, "--fiducial"], capture_output=True,
+            text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS":
+                 jax.default_backend()}).stdout
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception as e:                       # fiducial is evidence,
+        return {"fiducial_error": repr(e)}       # not a gate — record
+
+
+def _pools(bounds, spec):
+    """(mid, shallow) row pools, each exactly B rows (sigprune_ab)."""
+    init = interp.init_state(bounds)
+    frontier, seen, mid = [init], {init}, []
+    shallow, depth = [init], 0
+    while len(mid) < B:
+        if not frontier:
+            raise SystemExit(f"space exhausted below {B} distinct rows")
+        nxt = []
+        for s in frontier:
+            if not interp.constraint_ok(s, bounds):
+                continue
+            for _i, t in interp.successors(s, bounds, spec=spec):
+                if t not in seen:
+                    seen.add(t)
+                    nxt.append(t)
+        frontier = nxt
+        depth += 1
+        if depth <= 2:
+            shallow += [s for s in frontier
+                        if interp.constraint_ok(s, bounds)]
+        mid = [s for s in frontier if interp.constraint_ok(s, bounds)]
+    mid_rows = np.stack([interp.to_vec(s, bounds) for s in mid[:B]])
+    srows = np.stack([interp.to_vec(s, bounds) for s in shallow])
+    shallow_rows = np.tile(srows, (-(-B // len(srows)), 1))[:B]
+    return mid_rows, shallow_rows
+
+
+def _set_policy(policy):
+    for k in _GATES:
+        os.environ.pop(k, None)
+    if policy == "pinned":
+        os.environ["RAFT_TLA_PRESCAN"] = "off"
+        os.environ["RAFT_TLA_SIGPRUNE"] = "off"
+
+
+def _time_step(bounds, spec, invs, vecs, policy):
+    """(ms_xla, ms_mega), full-dict parity asserted bit-for-bit."""
+    out, ref = {}, None
+    for name, mega in (("xla", False), ("mega", True)):
+        _set_policy(policy)          # gates are read at build time
+        try:
+            fn = jax.jit(kernels.build_step(bounds, spec, invs,
+                                            ("Server",),
+                                            megakernel=mega))
+            r = fn(vecs)
+            jax.block_until_ready(r)
+        finally:
+            for k in _GATES:
+                os.environ.pop(k, None)
+        got = {k: np.asarray(v) for k, v in r.items()}
+        if ref is None:
+            ref = got
+        else:
+            for k in ref:
+                assert got[k].dtype == ref[k].dtype, k
+                assert np.array_equal(got[k], ref[k]), k
+        times = []
+        for _ in range(REPS):
+            t0 = time.monotonic()
+            jax.block_until_ready(fn(vecs))
+            times.append(time.monotonic() - t0)
+        out[name] = sorted(times)[len(times) // 2]
+    return out["xla"], out["mega"]
+
+
+results = {"platform": jax.devices()[0].platform, "chunk": B,
+           "reps": REPS, "step": {}, "inengine": {}}
+results["fiducial_start"] = _fiducial()
+print("fiducial_start:", json.dumps(results["fiducial_start"]),
+      flush=True)
+
+ARMS = [("flagship", FLAGSHIP, ("pinned", "auto")),
+        ("elect5", ELECT5, ("auto",))]
+for shape, (bounds, spec, invs), policies in ARMS:
+    mid, shallow = _pools(bounds, spec)
+    results["step"][shape] = {}
+    for policy in policies:
+        for pool, rows in (("mid", mid), ("shallow", shallow)):
+            ms_x, ms_m = _time_step(bounds, spec, invs,
+                                    jnp.asarray(rows), policy)
+            results["step"][shape][f"{policy}/{pool}"] = {
+                "ms_xla": round(ms_x * 1e3, 2),
+                "ms_mega": round(ms_m * 1e3, 2),
+                "mega_vs_xla": round(ms_x / ms_m, 3)}
+            print(f"{shape:9} {policy:6} {pool:8} "
+                  f"xla {ms_x * 1e3:8.2f} ms/chunk  "
+                  f"mega {ms_m * 1e3:8.2f} ms/chunk  "
+                  f"({ms_x / ms_m:5.2f}x)", flush=True)
+
+# in-engine: the northstar probe per arm, fresh DDD engines (the gate
+# is read at step-BUILD time), phase timers on for attribution — free
+# on CPU (RESULTS.md "Obs off-path A/B": timers arm 0.999x), rerun
+# timers-off before quoting chip numbers.  Parity: CPU chunk
+# scheduling is deterministic, so the n_states stream must agree on
+# every segment index both arms reached before their deadline.
+from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+
+cfg = CheckConfig(bounds=FLAGSHIP[0], spec="full",
+                  invariants=FLAGSHIP[2], symmetry=("Server",),
+                  chunk=4096)
+caps = DDDCapacities(block=1 << 20, table=1 << 22, flush=1 << 22,
+                     levels=128)
+streams = {}
+for mode in ("off", "on"):
+    _set_policy("auto")
+    os.environ["RAFT_TLA_MEGAKERNEL"] = mode
+    os.environ["RAFT_TLA_PHASE_TIMERS"] = "1"
+    stats: list = []
+    t0 = time.monotonic()
+    try:
+        r = DDDEngine(cfg, caps).check(deadline_s=DEADLINE_S,
+                                       on_progress=stats.append)
+    finally:
+        for k in _GATES + ("RAFT_TLA_PHASE_TIMERS",):
+            os.environ.pop(k, None)
+    wall = time.monotonic() - t0
+    streams[mode] = [s["n_states"] for s in stats]
+    phases: dict = {}
+    for s in stats:
+        for k, v in (s.get("phase_s") or {}).items():
+            phases[k] = phases.get(k, 0.0) + v
+    if len(stats) >= 2:              # warm rate, compile segment excluded
+        d_orbits = stats[-1]["n_states"] - stats[0]["n_states"]
+        d_wall = stats[-1]["wall_s"] - stats[0]["wall_s"]
+    else:
+        d_orbits, d_wall = r.n_states, wall
+    results["inengine"][mode] = {
+        "wall_s": round(wall, 2), "orbits": r.n_states,
+        "level": stats[-1]["level"] if stats else 0,
+        "orbits_per_sec": round(d_orbits / max(d_wall, 1e-9), 1),
+        "segments": len(stats),
+        "phase_s": {k: round(v, 2) for k, v in sorted(phases.items())}}
+    print(f"inengine  {mode:3}  {wall:7.2f} s  {r.n_states} orbits "
+          f"to level {results['inengine'][mode]['level']}  "
+          f"warm {results['inengine'][mode]['orbits_per_sec']:.0f}/s  "
+          f"phases {results['inengine'][mode]['phase_s']}", flush=True)
+n_common = min(len(streams["off"]), len(streams["on"]))
+assert n_common > 0, "an arm produced no segments"
+assert streams["off"][:n_common] == streams["on"][:n_common], \
+    "segment n_states parity failed"
+results["inengine"]["parity_segments"] = n_common
+results["inengine"]["mega_vs_xla_warm_rate"] = round(
+    results["inengine"]["on"]["orbits_per_sec"]
+    / max(results["inengine"]["off"]["orbits_per_sec"], 1e-9), 3)
+
+results["fiducial_end"] = _fiducial()
+print("fiducial_end:", json.dumps(results["fiducial_end"]), flush=True)
+print(json.dumps(results))

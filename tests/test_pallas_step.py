@@ -1,0 +1,308 @@
+"""Megakernel parity suite (ops/pallas_step vs the XLA step).
+
+The megakernel stages the XLA step's own jaxpr through a grid-blocked
+``pl.pallas_call`` (interpret mode on CPU), so these tests check the
+staging machinery — constant routing, int32 boundary casts, row-block
+padding, output reassembly — not a hand-kept twin.  Anchors:
+
+- full-dict bit-identity (every key, every lane, dtypes included) on
+  reachable chunks at |G| = 6, 24, 120, in parity AND faithful mode,
+  composed with Value symmetry and VIEW folding;
+- the same bit-identity under every orbit-scan variant the gates can
+  select (full scan, prescan ladder, sig-prune) — the variants ride
+  inside the staged program, so each combination is its own staging;
+- row-block padding edges (B not a block multiple, block larger than B);
+- a NumPy-oracle anchor: megakernel key lanes equal
+  ``sym.py_orbit_fingerprint`` of the corresponding PyState successor;
+- engine/serve-level gate parity on the 3014-state toy: counts,
+  diameter, coverage, violation + deadlock verdicts and traces all
+  identical with ``RAFT_TLA_MEGAKERNEL`` forced on, and serve bins
+  split on the gate so lanes can never mix step variants.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.engine import DEADLOCK, Engine
+from raft_tla_tpu.models import interp
+from raft_tla_tpu.models import spec as S
+from raft_tla_tpu.ops import kernels
+from raft_tla_tpu.ops import msgbits as mb
+from raft_tla_tpu.ops import pallas_step
+from raft_tla_tpu.ops import symmetry as sym
+
+pytestmark = pytest.mark.smoke
+
+TOY_BOUNDS = Bounds(n_servers=2, n_values=1, max_term=2, max_log=0,
+                    max_msgs=2)                      # 3014-state toy
+B3 = Bounds(n_servers=3, n_values=2, max_term=2, max_log=1, max_msgs=2)
+B4 = Bounds(n_servers=4, n_values=1, max_term=2, max_log=0, max_msgs=2)
+B5 = Bounds(n_servers=5, n_values=1, max_term=2, max_log=0, max_msgs=2)
+BH = Bounds(n_servers=2, n_values=2, max_term=2, max_log=1, max_msgs=2,
+            history=True, max_elections=4)
+BH3 = Bounds(n_servers=3, n_values=1, max_term=2, max_log=1, max_msgs=2,
+             history=True, max_elections=4)
+
+TOY = CheckConfig(bounds=TOY_BOUNDS, spec="election",
+                  invariants=("NoTwoLeaders",), chunk=256)
+TOY_SYM = CheckConfig(bounds=TOY_BOUNDS, spec="election",
+                      invariants=("NoTwoLeaders",), symmetry=("Server",),
+                      chunk=256)
+
+
+def _reach_vecs(bounds, spec, depth=3, cap=96, lane_cap=60):
+    """BFS-prefix bag of reachable states as packed device rows."""
+    frontier = [interp.init_state(bounds)]
+    seen = list(frontier)
+    for _ in range(depth):
+        nxt = []
+        for s in frontier:
+            nxt += [t for _i, t in interp.successors(s, bounds, spec=spec)]
+        frontier = nxt[:lane_cap]
+        seen += frontier
+    rows = np.stack([interp.to_vec(s, bounds) for s in seen[:cap]])
+    return jnp.asarray(rows, jnp.int32), seen[:cap]
+
+
+def _assert_step_parity(bounds, spec, invariants, symmetry, view=None,
+                        depth=3, cap=96, **mk_kwargs):
+    vecs, _states = _reach_vecs(bounds, spec, depth, cap)
+    xla = kernels.build_step(bounds, spec, invariants, symmetry, view,
+                             megakernel=False)
+    mega = pallas_step.build_step_megakernel(
+        bounds, spec, invariants, symmetry, view, **mk_kwargs)
+    a, b = xla(vecs), mega(vecs)
+    assert set(a) == set(b)
+    for k in sorted(a):
+        assert a[k].dtype == b[k].dtype, (k, a[k].dtype, b[k].dtype)
+        assert a[k].shape == b[k].shape, (k, a[k].shape, b[k].shape)
+        assert bool(jnp.all(a[k] == b[k])), (k, bounds, symmetry)
+    return b
+
+
+# -- chunk-level bit-identity ------------------------------------------------
+
+def test_toy_parity_no_symmetry():
+    """The symmetry-free path (plain fingerprints, no orbit scan)."""
+    _assert_step_parity(TOY_BOUNDS, "election", ("NoTwoLeaders",), (),
+                        depth=5, cap=128)
+
+
+def test_toy_parity_symmetry():
+    _assert_step_parity(TOY_BOUNDS, "election", ("NoTwoLeaders",),
+                        ("Server",), depth=5, cap=128)
+
+
+@pytest.mark.parametrize("bounds,spec,invariants,axes", [
+    (B3, "full", ("NoTwoLeaders", "LogMatching"), ("Server",)),   # |G|=6
+    (B4, "election", ("NoTwoLeaders",), ("Server",)),             # |G|=24
+    (B5, "election", ("NoTwoLeaders",), ("Server",)),             # |G|=120
+], ids=["G6", "G24", "G120"])
+def test_symmetry_suite_parity(bounds, spec, invariants, axes):
+    _assert_step_parity(bounds, spec, invariants, axes, cap=64)
+
+
+@pytest.mark.slow
+def test_value_symmetry_parity():
+    """Server x Value composition in parity mode (faithful-mode SV
+    composition rides tier-1 via test_faithful_parity[hist-SV])."""
+    _assert_step_parity(B3, "full", ("NoTwoLeaders",),
+                        ("Server", "Value"), cap=48)             # |G|=12
+
+
+@pytest.mark.parametrize("bounds,axes", [
+    (BH, ("Server", "Value")),                                   # |G|=4
+    pytest.param(BH3, ("Server",), marks=pytest.mark.slow),      # |G|=6
+], ids=["hist-SV", "hist-S6"])
+def test_faithful_parity(bounds, axes):
+    """History mode: the expansion postlude (allLogs) and the faithful
+    value-permutation LUTs ride the staged program too."""
+    _assert_step_parity(bounds, "full", ("NoTwoLeaders",), axes, cap=32)
+
+
+def test_view_parity():
+    _assert_step_parity(B3, "election", ("NoTwoLeaders",), ("Server",),
+                        view="deadvotes", cap=64)
+
+
+@pytest.mark.parametrize("prescan,sigprune", [
+    ("off", "off"),        # full scan
+    ("on", "off"),         # prescan-grouped (block-local in the kernel)
+    pytest.param("off", "on",      # sig-prune coset scan
+                 marks=pytest.mark.slow),
+    pytest.param("on", "on",       # composed
+                 marks=pytest.mark.slow),
+])
+def test_orbit_variant_parity(monkeypatch, prescan, sigprune):
+    """Each gate combination stages a different orbit phase into the
+    kernel; every one must stay bit-identical to its XLA twin.  The
+    sig-prune arms ride the slow tier (the coset-scan staging alone
+    traces ~40 s under interpret mode); tier-1 keeps the full scan and
+    the prescan ladder, and runs/megakernel_ab.py re-asserts pruned
+    parity at two shapes under the production auto policy every A/B."""
+    monkeypatch.setenv("RAFT_TLA_PRESCAN", prescan)
+    monkeypatch.setenv("RAFT_TLA_SIGPRUNE", sigprune)
+    _assert_step_parity(B3, "election", ("NoTwoLeaders",), ("Server",),
+                        cap=32)
+
+
+def test_block_padding_edges():
+    """B not a multiple of the row block — the zero-row padding in the
+    tail block must never leak into a real lane (grid of 2 at 50 rows)."""
+    _assert_step_parity(TOY_BOUNDS, "election", ("NoTwoLeaders",),
+                        ("Server",), depth=4, cap=50, block_rows=32)
+
+
+@pytest.mark.slow
+def test_block_larger_than_chunk():
+    """A block larger than the whole chunk: Bp = one padded block."""
+    _assert_step_parity(TOY_BOUNDS, "election", ("NoTwoLeaders",),
+                        ("Server",), depth=4, cap=50, block_rows=256)
+
+
+def test_oracle_anchor():
+    """Megakernel key lanes equal the NumPy oracle's orbit key of the
+    corresponding PyState successor (not just the XLA path's output)."""
+    vecs, states = _reach_vecs(B3, "election", depth=2, cap=8)
+    mega = pallas_step.build_step_megakernel(
+        B3, "election", (), ("Server",))
+    out = mega(vecs)
+    table = S.action_table(B3, "election")
+    checked = 0
+    for b, s in enumerate(states[:4]):
+        for idx, t in interp.successors(s, B3, table=table):
+            hi, lo = sym.py_orbit_fingerprint(t, B3, ("Server",))
+            assert bool(out["valid"][b, idx])
+            assert int(out["fp_hi"][b, idx]) == hi
+            assert int(out["fp_lo"][b, idx]) == lo
+            checked += 1
+    assert checked > 10
+
+
+# -- gate plumbing -----------------------------------------------------------
+
+def test_routed_step_refuses_megakernel():
+    with pytest.raises(ValueError, match="does not compose"):
+        kernels.build_step_routed(TOY_BOUNDS, "election", (), (),
+                                  k_rows=64, megakernel=True)
+
+
+def test_gate_env_resolution(monkeypatch):
+    monkeypatch.delenv("RAFT_TLA_MEGAKERNEL", raising=False)
+    assert not kernels._megakernel_enabled(TOY_BOUNDS, ())   # auto = OFF
+    monkeypatch.setenv("RAFT_TLA_MEGAKERNEL", "on")
+    assert kernels._megakernel_enabled(TOY_BOUNDS, ())
+    monkeypatch.setenv("RAFT_TLA_MEGAKERNEL", "off")
+    assert not kernels._megakernel_enabled(TOY_BOUNDS, ())
+
+
+def test_bin_key_splits_on_gate(monkeypatch):
+    """serve bins must never mix step variants across a gate flip."""
+    from raft_tla_tpu.serve.batch import bin_key
+    monkeypatch.setenv("RAFT_TLA_MEGAKERNEL", "off")
+    off = bin_key(TOY)
+    monkeypatch.setenv("RAFT_TLA_MEGAKERNEL", "on")
+    on = bin_key(TOY)
+    assert off != on
+    assert ("megakernel", True) in on and ("megakernel", False) in off
+
+
+def test_jitlint_covers_pallas_step():
+    """The jit-hazard lint scans ops/ by default; the megakernel module
+    must be in scope and clean."""
+    import os
+    from raft_tla_tpu.analysis import jitlint
+    assert any(t.endswith("raft_tla_tpu/ops") or t == "raft_tla_tpu"
+               for t in jitlint.DEFAULT_TARGETS)
+    path = os.path.join(os.path.dirname(pallas_step.__file__),
+                        "pallas_step.py")
+    with open(path) as fh:
+        findings = jitlint.lint_source(fh.read(), path)
+    assert findings == []
+
+
+# -- engine / serve parity on the 3014-state toy -----------------------------
+
+def assert_counts_equal(res, ref):
+    assert res.n_states == ref.n_states
+    assert res.diameter == ref.diameter
+    assert res.n_transitions == ref.n_transitions
+    assert list(res.levels) == list(ref.levels)
+    assert dict(res.coverage) == dict(ref.coverage)
+    assert res.complete and ref.complete
+
+
+def test_engine_gate_on_off_parity(monkeypatch):
+    monkeypatch.setenv("RAFT_TLA_MEGAKERNEL", "off")
+    ref_plain = Engine(TOY).check()
+    ref_sym = Engine(TOY_SYM).check()
+    monkeypatch.setenv("RAFT_TLA_MEGAKERNEL", "on")
+    got_plain = Engine(TOY).check()
+    got_sym = Engine(TOY_SYM).check()
+    assert ref_plain.n_states == 3014
+    assert_counts_equal(got_plain, ref_plain)
+    assert_counts_equal(got_sym, ref_sym)
+    assert got_sym.n_states < got_plain.n_states     # quotient held
+
+
+def bag(*ms):
+    return tuple(sorted((m, 1) for m in ms))
+
+
+VB = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0, max_msgs=4)
+VIOL = CheckConfig(bounds=VB, spec="election",
+                   invariants=("NaiveNoTwoLeaders",), chunk=256)
+DEAD = CheckConfig(bounds=Bounds(n_servers=1, n_values=1, max_term=2,
+                                 max_log=0, max_msgs=2),
+                   spec="election", invariants=(), check_deadlock=True,
+                   chunk=256)
+
+
+def seeded_start():
+    """Two steps from a NaiveNoTwoLeaders violation (engine-test seed)."""
+    return interp.init_state(VB)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3), votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100), msgs=bag(mb.rv_response(3, 1, 1, 2)))
+
+
+def test_engine_violation_and_deadlock_mask_parity(monkeypatch):
+    """The ok/inv mask lanes drive verdicts: a violating and a
+    deadlocking universe must reach the identical verdict AND trace
+    through the megakernel path."""
+    monkeypatch.setenv("RAFT_TLA_MEGAKERNEL", "off")
+    ref_viol = Engine(VIOL).check(init_override=seeded_start())
+    ref_dead = Engine(DEAD).check()
+    monkeypatch.setenv("RAFT_TLA_MEGAKERNEL", "on")
+    got_viol = Engine(VIOL).check(init_override=seeded_start())
+    got_dead = Engine(DEAD).check()
+
+    assert got_viol.violation is not None
+    assert got_viol.violation.invariant == "NaiveNoTwoLeaders"
+    assert got_viol.violation.trace == ref_viol.violation.trace
+    assert got_viol.violation.state == ref_viol.violation.state
+
+    assert got_dead.violation is not None
+    assert got_dead.violation.invariant == DEADLOCK \
+        == ref_dead.violation.invariant
+    assert got_dead.violation.trace == ref_dead.violation.trace
+
+
+def test_serve_lane_parity(monkeypatch):
+    """Lane-packed dispatches through the megakernel: completing lanes
+    stay byte-identical to solo runs."""
+    from raft_tla_tpu.serve.batch import BatchExecutor
+    monkeypatch.setenv("RAFT_TLA_MEGAKERNEL", "off")
+    solo = Engine(TOY).check()
+    solo_sym = Engine(TOY_SYM).check()
+    monkeypatch.setenv("RAFT_TLA_MEGAKERNEL", "on")
+    out = BatchExecutor(chunk=256).run(
+        [("a", TOY), ("b", TOY), ("sym", TOY_SYM)])
+    for jid in ("a", "b"):
+        assert out[jid].status == "completed"
+        assert_counts_equal(out[jid].result, solo)
+    assert out["sym"].status == "completed"
+    assert_counts_equal(out["sym"].result, solo_sym)

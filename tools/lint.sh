@@ -61,3 +61,14 @@ for r in recs:
 print(f"serve smoke ok: 2 jobs x {recs[0]['n_states']} states, "
       "per-tenant event logs valid")
 PY
+
+echo "== megakernel smoke (toy cfg, staged whole-step Pallas, CPU) =="
+# Gate forced ON: off-TPU this runs the kernel in Pallas interpret
+# mode (ops/pallas_compat.resolve), so the block walks the real
+# pallas_call staging path end-to-end inside a real engine.
+python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
+    --spec election --max-term 2 --max-log 0 --max-msgs 2 \
+    --chunk 256 --megakernel on --cpu --no-lint --no-trace \
+    | tee "$SERVE_TMP/megakernel.out" | tail -2
+grep -q "^3014 distinct states found" "$SERVE_TMP/megakernel.out" \
+    || { echo "megakernel smoke FAILED: expected 3014 states"; exit 1; }
