@@ -73,8 +73,22 @@ def lint_cfg(cfg: cfgparse.TLCConfig, bounds: Bounds, *,
     findings = []
 
     # -- unknown names --------------------------------------------------------
-    findings += _unknown("invariant", cfg.invariants, inv_mod.REGISTRY,
+    # Whole-line predicate EXPRESSIONS (frontend grammar) are not registry
+    # names: parse-check them instead of spell-checking them.
+    from raft_tla_tpu.frontend.predicate import is_expression
+    inv_names = [nm for nm in cfg.invariants if not is_expression(nm)]
+    inv_exprs = [nm for nm in cfg.invariants if is_expression(nm)]
+    findings += _unknown("invariant", inv_names, inv_mod.REGISTRY,
                          cfg, path)
+    for text in inv_exprs:
+        try:
+            inv_mod._expression(text)
+        except ValueError as e:
+            findings.append(Finding(
+                CFG, ERROR, "invariant-parse-error",
+                f"invariant expression {text!r} does not parse: {e}",
+                field=text, file=path,
+                line=cfg.line_of("invariant", text)))
     for text in cfg.properties:
         try:
             live_mod.parse_property(text)
@@ -212,13 +226,23 @@ def _vacuity(cfg, bounds, spec, path) -> list:
     menv = wc.message_envelope(bounds, env, active)
     for t in active.values():
         written |= set(t(bounds, env, menv).writes)
+    from raft_tla_tpu.frontend.predicate import is_expression
     init = interp.init_state(bounds)
     for name in cfg.invariants:
-        if name not in inv_mod.REGISTRY or name not in inv_mod.READS:
-            continue
-        if name in inv_mod.HISTORY_REGISTRY and not bounds.history:
-            continue                      # already an error above
-        reads = set(inv_mod.READS[name])
+        if name in inv_mod.REGISTRY:
+            if name not in inv_mod.READS:
+                continue
+            if name in inv_mod.HISTORY_REGISTRY and not bounds.history:
+                continue                  # already an error above
+            reads = set(inv_mod.READS[name])
+        elif is_expression(name):
+            # compiled expressions carry their own read-set
+            try:
+                reads = set(inv_mod._expression(name).reads)
+            except ValueError:
+                continue                  # parse error, reported upstream
+        else:
+            continue                      # unknown name, reported upstream
         if reads & written:
             continue
         try:

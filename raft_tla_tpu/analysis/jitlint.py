@@ -47,6 +47,7 @@ DEFAULT_TARGETS = (
     "raft_tla_tpu/parallel",
     "raft_tla_tpu/obs",
     "raft_tla_tpu/serve",
+    "raft_tla_tpu/frontend",
 )
 
 _NARROW_DTYPES = {"int8", "int16", "uint8", "uint16", "bfloat16", "float16",

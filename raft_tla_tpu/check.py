@@ -39,8 +39,11 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("cfg", help="TLC model config (e.g. the reference "
                                "raft.cfg); binds Server/Value/INVARIANT")
     p.add_argument("--spec", default="full",
-                   choices=("full", "election", "replication"),
-                   help="Next-disjunct subset (default: full raft.tla:454-465)")
+                   choices=("full", "election", "replication", "twophase"),
+                   help="loaded spec: a Raft Next-disjunct subset (default: "
+                        "full raft.tla:454-465) or the bundled twophase "
+                        "(two-phase commit, frontend-compiled; --engine "
+                        "host; cfg binds CONSTANT RM)")
     p.add_argument("--engine", default="device",
                    choices=("device", "paged", "streamed", "ddd", "shard",
                             "pagedshard", "ddd-shard", "host", "ref"),
@@ -468,6 +471,40 @@ def _run(args, config):
                      resume=args.resume, on_progress=_stats_cb(args))
 
 
+def _finish_run(args, p, config, props, model, b) -> int:
+    """Run + report for non-Raft (frontend-compiled) specs: the shared
+    tail of main() minus the Raft-only paths (liveness, reshard,
+    simulate), with trace rendering routed through the model."""
+    if args.reshard_to is not None:
+        print(f"Error: --reshard-to is not supported for --spec "
+              f"{args.spec}", file=sys.stderr)
+        return EXIT_ERROR
+    t0 = time.monotonic()
+    try:
+        result = _run(args, config)
+    except Exception as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return EXIT_ERROR
+    wall = time.monotonic() - t0
+    print(f"{result.n_states} distinct states found, diameter "
+          f"{result.diameter}, {result.n_transitions} transitions, "
+          f"{wall:.2f}s ({result.n_states / max(wall, 1e-9):,.0f} states/s).")
+    if args.coverage:
+        for fam, cnt in sorted(result.coverage.items()):
+            print(f"  {fam}: {cnt} new states")
+    if result.violation is None:
+        print("Model checking completed. No error has been found.")
+        return EXIT_OK
+    from raft_tla_tpu.engine import DEADLOCK
+    is_deadlock = result.violation.invariant == DEADLOCK
+    if args.no_trace:
+        print("Error: Deadlock reached." if is_deadlock else
+              f"Error: Invariant {result.violation.invariant} is violated.")
+    else:
+        print(model.render_trace(result.violation, b))
+    return EXIT_DEADLOCK if is_deadlock else EXIT_VIOLATION
+
+
 def main(argv=None) -> int:
     p = build_argparser()
     args = p.parse_args(argv)
@@ -530,17 +567,24 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"Error: {e}", file=sys.stderr)
         return EXIT_ERROR
+    from raft_tla_tpu.frontend import resolve_model
+    model = resolve_model(args.spec)
+    if not model.is_raft and args.engine not in model.engines:
+        p.error(f"--engine {args.engine} does not support spec "
+                f"{args.spec!r} (supported: {', '.join(model.engines)})")
+    if not model.is_raft and args.simulate is not None:
+        p.error(f"--simulate is Raft-only (got --spec {args.spec})")
 
     if not args.no_lint:
         # Width-safety (analysis Pass 1) before any step build: for these
         # exact bounds, no transition can write a value the bit-pack would
         # truncate.  Warn-only by default — the proof failing means the
         # analyzer and kernels disagree, which deserves eyes, not a wall —
-        # but --lint strict turns any finding into a hard stop.
+        # but --lint strict turns any finding into a hard stop.  Non-Raft
+        # models route to their schema validity gate.
         from raft_tla_tpu.analysis import report as _report
-        from raft_tla_tpu.analysis import widthcheck as _widthcheck
         try:
-            _lint = _widthcheck.check_widths(config.bounds, args.spec)
+            _lint = model.check_widths(config.bounds)
         except Exception as e:      # analyzer bug: report, don't block
             _lint = [_report.Finding(
                 _report.WIDTH, _report.ERROR, "lint-internal-error",
@@ -552,6 +596,21 @@ def main(argv=None) -> int:
                 return EXIT_ERROR
 
     b = config.bounds
+    if not model.is_raft:
+        print(f"raft_tla_tpu {__import__('raft_tla_tpu').__version__} — "
+              f"exhaustive check of spec {args.spec} (frontend-compiled)")
+        print(f"Universe: {b.n_servers} resource managers "
+              f"(from {args.cfg})")
+        print(f"Invariants: {', '.join(config.invariants) or '(none)'}")
+        if args.emit_tlc:
+            try:
+                tla, cfgp = model.emit_tla(args.emit_tlc, b,
+                                           config.invariants)
+            except (OSError, ValueError) as e:
+                print(f"Error: {e}", file=sys.stderr)
+                return EXIT_ERROR
+            print(f"TLC parity artifacts: {tla}, {cfgp}")
+        return _finish_run(args, p, config, props, model, b)
     print(f"raft_tla_tpu {__import__('raft_tla_tpu').__version__} — "
           f"exhaustive check of Spec (raft.tla:469), subset: {args.spec}")
     print(f"Universe: {b.n_servers} servers, {b.n_values} values "

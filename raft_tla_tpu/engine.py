@@ -44,11 +44,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tla_tpu.config import CheckConfig
-from raft_tla_tpu.models import interp, spec as S
+from raft_tla_tpu.models import interp
 from raft_tla_tpu.ops import fingerprint as fpr
-from raft_tla_tpu.ops import kernels
-from raft_tla_tpu.ops import state as st
-from raft_tla_tpu.ops import symmetry as sym_mod
 
 
 from raft_tla_tpu.models.refbfs import DEADLOCK  # noqa: E402  (sentinel)
@@ -115,16 +112,17 @@ def _next_pow2(x: int) -> int:
 class Engine:
     """Compiled checker for one :class:`CheckConfig`. Reusable across runs."""
 
-    def __init__(self, config: CheckConfig):
+    def __init__(self, config: CheckConfig, model=None):
+        from raft_tla_tpu.frontend import resolve_model
         self.config = config
         self.bounds = config.bounds
-        self.lay = st.Layout.of(self.bounds)
-        self.table = S.action_table(self.bounds, config.spec)
+        self.model = model if model is not None \
+            else resolve_model(config.spec)
+        self.lay = self.model.layout(self.bounds)
+        self.table = self.model.action_table(self.bounds)
         self.A = len(self.table)
         self.chunk = config.chunk
-        self._step = jax.jit(kernels.build_step(
-            self.bounds, config.spec, tuple(config.invariants),
-            config.symmetry, view=config.view))
+        self._step = jax.jit(self.model.build_step(config))
 
     # -- public API ----------------------------------------------------------
 
@@ -142,26 +140,24 @@ class Engine:
         inv_names = list(cfg.invariants)
 
         init_py = init_override if init_override is not None \
-            else interp.init_state(bounds)
-        init_vec = interp.to_vec(init_py, bounds)
-        init_struct = interp.to_struct(init_py, bounds)
-        hi0, lo0 = sym_mod.init_fingerprint(self.config, init_py,
-                                            init_vec)
+            else self.model.init_py(bounds)
+        init_vec = self.model.to_vec(init_py, bounds)
+        hi0, lo0 = self.model.init_fingerprint(self.config, init_py,
+                                               init_vec)
         init_key = int(fpr.to_u64(hi0, lo0))
 
         seen: set[int] = {init_key}
         store = _VecStore(W)
         store.append(init_vec[None, :])
         parents: list = [None]               # global idx -> (parent, lane) | None
-        con_flags = [bool(interp.constraint_ok(init_py, bounds))]
+        con_flags = [self.model.constraint_ok(init_py, bounds)]
         coverage: Counter = Counter()
         levels = [1]
         n_transitions = 0
         violation: Optional[Violation] = None
 
-        from raft_tla_tpu.models import invariants as inv_mod
         for nm in inv_names:
-            if not inv_mod.py_invariant(nm)(init_py, bounds):
+            if not self.model.py_invariant(nm)(init_py, bounds):
                 violation = self._make_violation(nm, 0, store, parents)
                 break
 
@@ -295,8 +291,7 @@ class Engine:
         chain = []
         cur: Optional[int] = gidx
         while cur is not None:
-            py = interp.from_struct(
-                st.unpack(store.get(cur), self.lay, np), self.bounds)
+            py = self.model.from_vec(store.get(cur), self.bounds)
             entry = parents[cur]
             label = self.table[entry[1]].label() if entry else None
             chain.append((label, py))

@@ -1,0 +1,40 @@
+"""Spec-generic frontend — compile bounded TLA+ subsets, not just Raft.
+
+The engines, dedup stores, symmetry, views, liveness, obs, and serve
+layers are model-agnostic in shape; only ``models/spec.py`` +
+``ops/kernels.py`` were Raft-specific.  This package is the seam that
+makes "one checker, many protocols" real (ROADMAP item 7):
+
+- ``schema``     — declared tensor state schemas (fields, shapes, ranges)
+- ``predicate``  — quantifier-free boolean predicate compiler accepted in
+                   INVARIANT stanzas of any loaded spec (dual numpy/jnp)
+- ``expr``       — the action-definition IR: guards, per-field updates,
+                   bag/message ops over a schema
+- ``actions``    — the IR compiler: IR -> fused per-family kernels with
+                   the exact ``(bounds, s, *params) -> (out, valid, ovf)``
+                   contract ``ops/kernels.grouped_dispatch`` expects, plus
+                   a generic ``build_step`` for non-Raft schemas
+- ``widthgen``   — speclint Pass-1 transfer twins *generated from the IR*
+                   (cross-checked bit-for-bit against the hand twins)
+- ``raft_schema``— the Raft field table + action table as a schema
+                   instance (``models/spec.py`` re-exports it)
+- ``raft_ir``    — Raft transcribed into the IR: the first client of the
+                   compiler, bit-identical to the hand-written kernels
+- ``twophase``   — the second bundled spec: bounded two-phase commit,
+                   checked end-to-end with a NumPy reference oracle
+- ``registry``   — ``resolve_model(spec)``: one name -> model adapter
+"""
+
+from raft_tla_tpu.frontend.predicate import compile_predicate, is_expression
+
+
+def resolve_model(spec: str):
+    """Lazy re-export of :func:`raft_tla_tpu.frontend.registry.
+    resolve_model` — deferred because the registry pulls in the kernel
+    layer, which itself imports ``models/spec`` (a re-export of
+    ``frontend/raft_schema``); an eager import here would cycle."""
+    from raft_tla_tpu.frontend.registry import resolve_model as _resolve
+    return _resolve(spec)
+
+
+__all__ = ["compile_predicate", "is_expression", "resolve_model"]

@@ -1,0 +1,191 @@
+"""The IR compiler: ActionDefs -> fused per-family kernels.
+
+:func:`compile_kernels` lowers a spec's :class:`~raft_tla_tpu.frontend.
+expr.ActionDef` table to kernels with the exact
+``(bounds, s, *params) -> (out, valid, ovf)`` contract that
+``ops/kernels.grouped_dispatch`` vmaps — so an IR-defined spec (or Raft
+itself, via ``frontend/raft_ir``) rides the existing fused
+expand→canonicalize→dedup step untouched.  The lowering deliberately
+calls the hand-written helper layer (``_set1``/``_set2``/``bag_add``/
+``reply``/``_tree_select``) rather than re-deriving it: equal IR
+semantics then produce *bit-identical* lanes, which is what the Raft
+parity tests pin down.
+
+:func:`build_schema_step` is the generic step builder for specs declared
+purely as a schema + IR (no hand kernels at all): same step-dict
+contract as ``kernels.build_step`` — plain lane fingerprints, vmapped
+predicate invariants, identity canonicalization unless the spec
+declares one.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from raft_tla_tpu.frontend import expr as E
+from raft_tla_tpu.ops import fingerprint as fpr
+from raft_tla_tpu.ops import kernels as K
+
+I32 = jnp.int32
+
+
+def _as_array_bool(v):
+    """Python bools (a Lit(True) validity) become traced scalars so the
+    dispatch loop can broadcast them like the hand kernels'
+    ``jnp.bool_(True)``."""
+    return jnp.bool_(v) if isinstance(v, bool) else v
+
+
+def _apply_update(ctx, out, u):
+    """One field write on the branch struct; values read the pre-state
+    through ``ctx`` (the hand kernels' functional idiom)."""
+    arr = out[u.field]
+    if isinstance(u, E.Set1):
+        written = K._set1(arr, u.i.ev(ctx), u.val.ev(ctx))
+    elif isinstance(u, E.SetRow):
+        return K._set_row(arr, u.i.ev(ctx), u.val.ev(ctx))
+    elif isinstance(u, E.Set2):
+        written = K._set2(arr, u.i.ev(ctx), u.j.ev(ctx), u.val.ev(ctx))
+    else:
+        raise TypeError(f"unknown update node {type(u).__name__}")
+    cond = getattr(u, "cond", None)
+    if cond is None:
+        return written
+    return jnp.where(cond.ev(ctx), written, arr)
+
+
+def _pack_words(ctx, msg):
+    """Evaluate a PackMsg into the (hi, lo) packed int32 words —
+    value-identical to the ``ops/msgbits`` constructors (same shifts,
+    OR-composition of non-negative subfields)."""
+    from raft_tla_tpu.ops import msgbits as mb
+    vals = {"mtype": msg.mtype}
+    for name, e in msg.fields:
+        v = e.ev(ctx)
+        if hasattr(v, "dtype") and v.dtype == jnp.bool_:
+            v = v.astype(I32)
+        vals[name] = v
+    words = []
+    for table in (mb.HI_FIELDS, mb.LO_FIELDS):
+        w = None
+        for name, (shift, _width) in table.items():
+            v = vals.get(name)
+            if v is None:
+                continue
+            t = (v << shift) if shift else v
+            w = t if w is None else (w | t)
+        words.append(jnp.int32(0) if w is None else w)
+    return words[0], words[1]
+
+
+def _branch_effects(ctx, s, br):
+    """Apply one branch: field updates, then bag ops in order.  Returns
+    (out_struct, ovf_or_None)."""
+    out = dict(s)
+    for u in br.updates:
+        out[u.field] = _apply_update(ctx, out, u)
+    ovf = None
+    for op in br.ops:
+        if isinstance(op, E.BagAdd):
+            hi, lo = _pack_words(ctx, op.msg)
+            out, o = K.bag_add(out, hi, lo)
+        elif isinstance(op, E.BagRemove):
+            mhi, mlo = ctx.msg_words()
+            out = K.bag_remove(out, mhi, mlo)
+            continue
+        elif isinstance(op, E.Reply):
+            hi, lo = _pack_words(ctx, op.msg)
+            mhi, mlo = ctx.msg_words()
+            out, o = K.reply(out, hi, lo, mhi, mlo)
+        else:
+            raise TypeError(f"unknown bag op {type(op).__name__}")
+        ovf = o if ovf is None else (ovf | o)
+    if br.overflow is not None:
+        o = br.overflow.ev(ctx)
+        ovf = o if ovf is None else (ovf | o)
+    return out, ovf
+
+
+def _compile_action(adef):
+    """ActionDef -> kernel(bounds, s, *params) with the grouped_dispatch
+    contract."""
+
+    def kern(bounds, s, *args):
+        ctx = E.Ctx(bounds, s, dict(zip(adef.params, args)), jnp)
+        valid = _as_array_bool(adef.valid.ev(ctx))
+        if len(adef.branches) == 1 and adef.branches[0].guard is None:
+            out, contrib = _branch_effects(ctx, s, adef.branches[0])
+            total = contrib
+        else:
+            pairs, guards, total = [], [], None
+            for br in adef.branches:
+                g = br.guard.ev(ctx)
+                b_out, contrib = _branch_effects(ctx, s, br)
+                pairs.append((g, b_out))
+                guards.append(g)
+                if contrib is not None:
+                    t = g & contrib
+                    total = t if total is None else (total | t)
+            out = K._tree_select(pairs, s)
+            if adef.any_guard_valid:
+                valid = valid & functools.reduce(jnp.logical_or, guards)
+        ovf = jnp.bool_(False) if total is None else (valid & total)
+        return out, valid, ovf
+
+    kern.__name__ = f"ir_{adef.family.lower()}"
+    return kern
+
+
+def compile_kernels(defs):
+    """IR table -> ``{family: (kernel, params)}``, the shape
+    ``grouped_dispatch(..., family_kernels=...)`` consumes."""
+    return {adef.family: (_compile_action(adef), adef.params)
+            for adef in defs}
+
+
+def build_schema_step(schema, defs, table, bounds, predicates=()):
+    """Generic fused step for a schema-declared spec.
+
+    ``table`` is the action-instance list (objects with ``.family`` and
+    the per-family param attributes), ``predicates`` the compiled
+    invariant :class:`~raft_tla_tpu.frontend.predicate.Predicate` probes
+    (order = CheckConfig.invariants).  Returns ``step(vecs[B, W]) ->
+    dict`` with the exact key set/shapes ``kernels.build_step``
+    produces: svecs, valid, overflow, fp_hi/fp_lo (uint32 lanes),
+    inv_ok, con_ok.  Canonicalization is the identity (a schema spec
+    declares no bag-slot permutation) and ``con_ok`` is all-true; both
+    are points where a future schema hook can slot in.
+    """
+    lay = schema.layout(bounds)
+    consts = jnp.asarray(fpr.lane_constants(lay.width))
+    fam_kernels = compile_kernels(defs)
+    groups = K.group_instances(table)
+
+    def expand(s):
+        succs, valids, ovfs = K.grouped_dispatch(
+            bounds, s, groups, family_kernels=fam_kernels)
+        all_succs = jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *succs)
+        return (all_succs,
+                jnp.concatenate(valids, axis=0),
+                jnp.concatenate(ovfs, axis=0))
+
+    def step(vecs):
+        structs = jax.vmap(lambda v: lay.unpack(v, jnp))(vecs)
+        succs, valid, ovf = jax.vmap(expand)(structs)
+        svecs = jax.vmap(jax.vmap(lambda t: lay.pack(t, jnp)))(succs)
+        fp_hi, fp_lo = fpr.fingerprint(svecs, consts, jnp)
+        if predicates:
+            inv_ok = jnp.stack(
+                [jax.vmap(jax.vmap(lambda t, p=p: p.ev(t, jnp)))(succs)
+                 for p in predicates], axis=-1)
+        else:
+            inv_ok = jnp.ones(valid.shape + (0,), dtype=bool)
+        return {"svecs": svecs, "valid": valid, "overflow": ovf,
+                "fp_hi": fp_hi, "fp_lo": fp_lo, "inv_ok": inv_ok,
+                "con_ok": jnp.ones_like(valid)}
+
+    return step

@@ -1,0 +1,260 @@
+"""``resolve_model(spec)`` — one spec name, one model adapter.
+
+The engines, serve lanes, and CLI never hard-code a protocol; they ask
+the registry for a *model adapter* and go through its uniform surface:
+
+- ``layout(bounds)`` / ``action_table(bounds)`` / ``build_step(config)``
+  — the compiled step (same fused contract for every model);
+- ``init_py`` / ``to_vec`` / ``from_vec`` / ``init_fingerprint`` /
+  ``constraint_ok`` / ``py_invariant`` — the host-side half of the BFS
+  (roots, trace decoding, frontier invariant probes);
+- ``render_state`` / ``render_trace`` — violation reporting;
+- ``check_widths(bounds)`` — the admission-time width/validity gate;
+- ``resolve_check_config(cfg, opts, path)`` — cfg-file -> CheckConfig
+  for models that own their cfg mapping (non-Raft specs).
+
+Raft resolves to :class:`RaftModel` (pure delegation to the existing
+modules — zero behavior change), with ``ir-full`` / ``ir-election`` /
+``ir-replication`` the same model stepped through
+``frontend/raft_ir``-compiled kernels instead of the hand-written ones
+(pinned bit-identical by tests).  ``twophase`` resolves to the bundled
+two-phase-commit spec, compiled entirely from frontend declarations.
+
+Everything heavy imports inside methods: this module sits under
+``frontend/__init__`` which ``models/spec.py``'s re-export pulls in, so
+module level must stay light to avoid import cycles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftModel:
+    """The built-in Raft model; ``sub`` is the Next-subset family table
+    name (``full``/``election``/``replication``), ``use_ir`` swaps the
+    hand-written family kernels for the IR-compiled ones."""
+
+    name: str
+    sub: str
+    use_ir: bool = False
+    is_raft: bool = True
+    engines: tuple = ("device", "host", "ref", "simulate")
+
+    def layout(self, bounds):
+        from raft_tla_tpu.ops import state as st
+        return st.Layout.of(bounds)
+
+    def action_table(self, bounds):
+        from raft_tla_tpu.models import spec as S
+        return S.action_table(bounds, self.sub)
+
+    def build_step(self, config: CheckConfig):
+        from raft_tla_tpu.ops import kernels
+        fk = None
+        if self.use_ir:
+            from raft_tla_tpu.frontend import raft_ir
+            fk = raft_ir.family_kernels(config.bounds)
+        return kernels.build_step(
+            config.bounds, self.sub, tuple(config.invariants),
+            tuple(config.symmetry), view=config.view, family_kernels=fk)
+
+    def init_py(self, bounds):
+        from raft_tla_tpu.models import interp
+        return interp.init_state(bounds)
+
+    def to_vec(self, py, bounds):
+        from raft_tla_tpu.models import interp
+        return interp.to_vec(py, bounds)
+
+    def from_vec(self, vec, bounds):
+        from raft_tla_tpu.models import interp
+        from raft_tla_tpu.ops import state as st
+        return interp.from_struct(
+            st.unpack(vec, st.Layout.of(bounds), np), bounds)
+
+    def init_fingerprint(self, config, init_py, init_vec):
+        from raft_tla_tpu.ops import symmetry as sym_mod
+        return sym_mod.init_fingerprint(config, init_py, init_vec)
+
+    def constraint_ok(self, py, bounds) -> bool:
+        from raft_tla_tpu.models import interp
+        return bool(interp.constraint_ok(py, bounds))
+
+    def py_invariant(self, name):
+        from raft_tla_tpu.models import invariants as inv_mod
+        return inv_mod.py_invariant(name)
+
+    def render_state(self, py, bounds, indent="    "):
+        from raft_tla_tpu.utils import render
+        return render.render_state(py, bounds, indent)
+
+    def render_trace(self, violation, bounds):
+        from raft_tla_tpu.utils import render
+        return render.render_trace(violation, bounds)
+
+    def check_widths(self, bounds):
+        from raft_tla_tpu.analysis import widthcheck
+        return widthcheck.check_widths(bounds, self.sub)
+
+
+class TwoPhaseModel:
+    """Bounded two-phase commit, compiled from frontend declarations
+    (``frontend/twophase``): schema layout, IR-built step, predicate
+    invariants.  ``bounds.n_servers`` is the RM count; the other bound
+    knobs are inert for this state space."""
+
+    name = "twophase"
+    sub = "twophase"
+    is_raft = False
+    use_ir = True
+    engines = ("host",)
+
+    def _mod(self):
+        from raft_tla_tpu.frontend import twophase
+        return twophase
+
+    def _predicate(self, name: str):
+        from raft_tla_tpu.frontend.predicate import (compile_predicate,
+                                                     is_expression)
+        tp = self._mod()
+        text = tp.INVARIANTS.get(name)
+        if text is None:
+            if not is_expression(name):
+                raise ValueError(
+                    f"unknown twophase invariant {name!r} (known: "
+                    f"{', '.join(sorted(tp.INVARIANTS))}; or write a "
+                    "predicate expression over the state fields)")
+            text = name
+        return compile_predicate(text, fields=tp.SCHEMA.field_names)
+
+    def layout(self, bounds):
+        return self._mod().SCHEMA.layout(bounds)
+
+    def action_table(self, bounds):
+        return self._mod().action_table(bounds)
+
+    def build_step(self, config: CheckConfig):
+        from raft_tla_tpu.frontend import actions
+        tp = self._mod()
+        preds = tuple(self._predicate(nm) for nm in config.invariants)
+        return actions.build_schema_step(
+            tp.SCHEMA, tp.ACTIONS, tp.action_table(config.bounds),
+            config.bounds, predicates=preds)
+
+    def init_py(self, bounds):
+        return self._mod().init_state(bounds)
+
+    def to_vec(self, py, bounds):
+        return self._mod().to_vec(py, bounds)
+
+    def from_vec(self, vec, bounds):
+        return self._mod().from_vec(vec, bounds)
+
+    def init_fingerprint(self, config, init_py, init_vec):
+        # symmetry/view are rejected at config time, so this always takes
+        # the generic lane-constants branch — the same fingerprint the
+        # compiled schema step computes on device.
+        from raft_tla_tpu.ops import symmetry as sym_mod
+        return sym_mod.init_fingerprint(config, init_py, init_vec)
+
+    def constraint_ok(self, py, bounds) -> bool:
+        return True      # the state space is finite with no constraint
+
+    def py_invariant(self, name):
+        tp = self._mod()
+        pred = self._predicate(name)
+
+        def check(py, bounds) -> bool:
+            lay = tp.SCHEMA.layout(bounds)
+            struct = lay.unpack(tp.to_vec(py, bounds), np)
+            return bool(pred.ev(struct, np))
+
+        return check
+
+    def render_state(self, py, bounds, indent="    "):
+        return self._mod().render_state(py, bounds, indent)
+
+    def render_trace(self, violation, bounds):
+        return self._mod().render_trace(violation, bounds)
+
+    def check_widths(self, bounds):
+        from raft_tla_tpu.frontend.schema import check_schema
+        return check_schema(self._mod().SCHEMA, bounds)
+
+    def emit_tla(self, out_dir, bounds, invariants=()):
+        return self._mod().emit_tla(out_dir, bounds, invariants)
+
+    def resolve_check_config(self, cfg, opts, path=None):
+        """TLC cfg -> (CheckConfig, properties) for the twophase model —
+        the non-Raft face of ``serve/jobs.resolve_check_config``."""
+        tp = self._mod()
+        where = path or "cfg"
+        if cfg.specification not in (None, "Spec"):
+            raise ValueError(
+                f"{where}: twophase checks SPECIFICATION Spec only "
+                f"(got {cfg.specification!r})")
+        if cfg.init not in (None, "Init") or cfg.next not in (None, "Next"):
+            raise ValueError(
+                f"{where}: twophase supports INIT Init / NEXT Next only")
+        if cfg.properties:
+            raise ValueError(
+                f"{where}: temporal properties are not supported for "
+                "twophase")
+        if cfg.constraints:
+            raise ValueError(
+                f"{where}: twophase is finite; CONSTRAINT is not supported")
+        if cfg.symmetry or opts.symmetry:
+            raise ValueError("symmetry reduction is not supported for "
+                             "twophase")
+        if cfg.view or opts.view:
+            raise ValueError("views are not supported for twophase")
+        if opts.faithful:
+            raise ValueError("faithful mode (history variables) is "
+                             "Raft-specific")
+        rms = cfg.constants.get("RM", cfg.constants.get("Server"))
+        if not isinstance(rms, list) or not rms:
+            raise ValueError(
+                f"{where}: twophase needs CONSTANT RM = {{r1, ...}} "
+                "(a nonempty finite set)")
+        invariants = tuple(cfg.invariants) or (tp.DEFAULT_INVARIANT,)
+        for nm in invariants:        # parse/validate now, fail loudly here
+            self._predicate(nm)
+        bounds = Bounds(n_servers=len(rms), n_values=1)
+        config = CheckConfig(
+            bounds=bounds, spec="twophase", invariants=invariants,
+            symmetry=(), chunk=opts.chunk, check_deadlock=opts.deadlock,
+            view=None)
+        return config, ()
+
+
+_RAFT_SUBS = ("full", "election", "replication")
+
+
+def known_specs() -> tuple:
+    return _RAFT_SUBS + tuple(f"ir-{s}" for s in _RAFT_SUBS) + (
+        "raft", "twophase")
+
+
+def resolve_model(spec: str):
+    """Spec name -> model adapter.  Unknown names raise with a
+    did-you-mean, mirroring the cfg-name diagnostics."""
+    if spec in _RAFT_SUBS:
+        return RaftModel(spec, spec)
+    if spec == "raft":
+        return RaftModel("raft", "full")
+    if spec.startswith("ir-") and spec[3:] in _RAFT_SUBS:
+        return RaftModel(spec, spec[3:], use_ir=True)
+    if spec == "twophase":
+        return TwoPhaseModel()
+    from raft_tla_tpu.utils import cfgparse
+    hints = cfgparse.suggest(spec, known_specs())
+    hint_txt = f" (did you mean: {', '.join(hints)}?)" if hints else ""
+    raise ValueError(
+        f"unknown spec {spec!r}{hint_txt}; known: "
+        f"{', '.join(sorted(known_specs()))}")

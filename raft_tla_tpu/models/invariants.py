@@ -22,6 +22,8 @@ predicate over the tensor struct (vmapped over the frontier, device side).
 
 from __future__ import annotations
 
+import functools
+
 from raft_tla_tpu.config import Bounds
 from raft_tla_tpu.models import spec as S
 
@@ -290,9 +292,34 @@ READS = {
 }
 
 
+@functools.lru_cache(maxsize=None)
+def _expression(text: str):
+    """Compile a non-registry invariant as a frontend predicate over the
+    Raft state schema (cached — cfg text recurs per step build)."""
+    from raft_tla_tpu.frontend.predicate import compile_predicate
+    from raft_tla_tpu.models import spec as S
+    return compile_predicate(text, fields=S.RAFT_SCHEMA.field_names)
+
+
 def py_invariant(name: str):
-    return REGISTRY[name][0]
+    if name in REGISTRY:
+        return REGISTRY[name][0]
+    pred = _expression(name)
+
+    def check(s, bounds) -> bool:
+        import numpy as np
+        from raft_tla_tpu.models import interp
+        from raft_tla_tpu.ops import state as st
+        struct = st.unpack(interp.to_vec(s, bounds), st.Layout.of(bounds),
+                           np)
+        return bool(pred.ev(struct, np))
+
+    return check
 
 
 def jnp_invariant(name: str, bounds: Bounds):
-    return REGISTRY[name][1](bounds)
+    if name in REGISTRY:
+        return REGISTRY[name][1](bounds)
+    pred = _expression(name)
+    import jax.numpy as jnp
+    return lambda s: pred.ev(s, jnp)

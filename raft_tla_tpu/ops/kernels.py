@@ -507,12 +507,19 @@ def group_instances(table):
     return groups
 
 
-def grouped_dispatch(bounds, s, groups):
+def grouped_dispatch(bounds, s, groups, family_kernels=None):
     """Evaluate the family kernels over grouped static instances:
-    ``-> (succs list, valids list, ovfs list)`` of per-group arrays."""
+    ``-> (succs list, valids list, ovfs list)`` of per-group arrays.
+
+    ``family_kernels`` overrides the hand-written kernel table with one
+    of the same shape (``{family: (kernel, params)}``) — the seam the
+    frontend IR compiler plugs into (frontend/actions.compile_kernels);
+    the dispatch, vmapping and broadcast semantics stay this one
+    definition either way."""
+    table = _FAMILY_KERNELS if family_kernels is None else family_kernels
     succs, valids, ovfs = [], [], []
     for fam, instances in groups:
-        kern, params = _FAMILY_KERNELS[fam]
+        kern, params = table[fam]
         args = [jnp.asarray([getattr(a, p) for a in instances], dtype=I32)
                 for p in params]
         fn = functools.partial(kern, bounds)
@@ -537,17 +544,20 @@ def finish_expand(bounds, s, succs, valids, ovfs):
     return all_succs, jnp.concatenate(valids), jnp.concatenate(ovfs)
 
 
-def build_expand(bounds: Bounds, spec: str = "full"):
+def build_expand(bounds: Bounds, spec: str = "full", family_kernels=None):
     """Build ``expand(struct) -> (succs[A,...], valid[A], overflow[A])``.
 
     The A successor lanes follow models/spec.action_table order exactly;
     every successor is canonicalized (message slots sorted).  Pure function
     of a single state struct — vmap/jit at the call site.
+    ``family_kernels`` swaps in an alternative kernel table (the IR
+    compiler's output) under the same table order and postlude.
     """
     groups = group_instances(SP.action_table(bounds, spec))
 
     def expand(s):
-        succs, valids, ovfs = grouped_dispatch(bounds, s, groups)
+        succs, valids, ovfs = grouped_dispatch(
+            bounds, s, groups, family_kernels=family_kernels)
         return finish_expand(bounds, s, succs, valids, ovfs)
 
     return expand
@@ -572,7 +582,8 @@ def _alllogs_update(bounds, s, n_lanes):
 
 
 def _step_stages(bounds: Bounds, spec: str, invariants: tuple,
-                 symmetry: tuple, view: str | None = None):
+                 symmetry: tuple, view: str | None = None,
+                 family_kernels=None):
     """The shared builder prologue of the dense and EP-routed steps:
     layout, fingerprint constants, the expansion, the invariant
     predicates, the orbit-fingerprint pipeline, and the dedup-key view.
@@ -584,7 +595,7 @@ def _step_stages(bounds: Bounds, spec: str, invariants: tuple,
 
     lay = st.Layout.of(bounds)
     consts = jnp.asarray(fpr.lane_constants(lay.width))
-    expand = build_expand(bounds, spec)
+    expand = build_expand(bounds, spec, family_kernels=family_kernels)
     inv_fns = [inv_mod.jnp_invariant(nm, bounds) for nm in invariants]
     # Scan-compiled orbit pass: ONE copy of the permute/canonicalize/pack/
     # fingerprint pipeline iterated over the n!*V! group, not n!*V!
@@ -623,7 +634,7 @@ def _step_stages(bounds: Bounds, spec: str, invariants: tuple,
 
 def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
                symmetry: tuple = (), view: str | None = None,
-               megakernel: bool | None = None):
+               megakernel: bool | None = None, family_kernels=None):
     """One fused frontier step: packed vecs -> everything the engine needs.
 
     ``step(vecs[B, W]) -> dict`` with packed successors ``svecs [B, A, W]``,
@@ -650,10 +661,19 @@ def build_step(bounds: Bounds, spec: str = "full", invariants: tuple = (),
     if megakernel is None:
         megakernel = _megakernel_enabled(bounds, symmetry)
     if megakernel:
+        if family_kernels is not None:
+            # The megakernel stages the HAND kernel bodies; an IR kernel
+            # table has no fused build.  Refuse loudly rather than
+            # silently dropping the override.
+            raise ValueError(
+                "RAFT_TLA_MEGAKERNEL=on does not compose with a "
+                "family_kernels override (IR-compiled specs); leave the "
+                "megakernel gate auto/off")
         from raft_tla_tpu.ops import pallas_step
         return pallas_step.build_step_megakernel(
             bounds, spec, invariants, symmetry, view)
-    stages = _step_stages(bounds, spec, invariants, symmetry, view)
+    stages = _step_stages(bounds, spec, invariants, symmetry, view,
+                          family_kernels=family_kernels)
     lay = stages[0]
     expand = stages[2]
 
@@ -892,7 +912,7 @@ def apply_stages(bounds, stages, symmetry, succs, svecs, valid):
 def build_step_routed(bounds: Bounds, spec: str = "full",
                       invariants: tuple = (), symmetry: tuple = (),
                       k_rows: int = 0, view: str | None = None,
-                      megakernel: bool | None = None):
+                      megakernel: bool | None = None, family_kernels=None):
     """EP-style routed frontier step (SURVEY §2.9, EP row): compact the
     enabled lanes, then run the expensive per-candidate stages densely.
 
@@ -945,7 +965,8 @@ def build_step_routed(bounds: Bounds, spec: str = "full",
             "step (build_step_routed); use the dense step (--route 0) or "
             "leave the megakernel gate auto/off")
     (lay, consts, expand, inv_fns, orbit_fp,
-     viewer) = _step_stages(bounds, spec, invariants, symmetry, view)
+     viewer) = _step_stages(bounds, spec, invariants, symmetry, view,
+                            family_kernels=family_kernels)
     if k_rows <= 0:
         raise ValueError(f"k_rows={k_rows} must be positive")
     K = int(k_rows)

@@ -39,12 +39,9 @@ import numpy as np
 
 from raft_tla_tpu.config import CheckConfig
 from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation, _VecStore
-from raft_tla_tpu.models import interp, spec as S
 from raft_tla_tpu.obs import RunTelemetry
 from raft_tla_tpu.ops import fingerprint as fpr
 from raft_tla_tpu.ops import kernels
-from raft_tla_tpu.ops import state as st
-from raft_tla_tpu.ops import symmetry as sym_mod
 
 
 def bin_key(config: CheckConfig) -> tuple:
@@ -89,11 +86,14 @@ class _Lane:
     factored out so N of them can interleave on one compiled step."""
 
     def __init__(self, job_id: str, config: CheckConfig, table, lay,
-                 tel: RunTelemetry | None = None, init_override=None):
-        from raft_tla_tpu.models import invariants as inv_mod
-
+                 tel: RunTelemetry | None = None, init_override=None,
+                 model=None):
+        if model is None:
+            from raft_tla_tpu.frontend import resolve_model
+            model = resolve_model(config.spec)
         self.job_id = job_id
         self.config = config
+        self.model = model
         self.table = table
         self.A = len(table)
         self.lay = lay
@@ -102,9 +102,9 @@ class _Lane:
 
         bounds = config.bounds
         init_py = init_override if init_override is not None \
-            else interp.init_state(bounds)
-        init_vec = interp.to_vec(init_py, bounds)
-        hi0, lo0 = sym_mod.init_fingerprint(config, init_py, init_vec)
+            else model.init_py(bounds)
+        init_vec = model.to_vec(init_py, bounds)
+        hi0, lo0 = model.init_fingerprint(config, init_py, init_vec)
         self.seen: set[int] = {int(fpr.to_u64(hi0, lo0))}
         self.store = _VecStore(lay.width)
         self.store.append(init_vec[None, :])
@@ -121,11 +121,11 @@ class _Lane:
         if tel is not None:
             tel.run_start()
         for nm in config.invariants:
-            if not inv_mod.py_invariant(nm)(init_py, bounds):
+            if not model.py_invariant(nm)(init_py, bounds):
                 self.violation = self._make_violation(nm, 0)
                 break
         self.frontier = [0] if self.violation is None and \
-            interp.constraint_ok(init_py, bounds) else []
+            model.constraint_ok(init_py, bounds) else []
         self.cursor = 0
         if self.violation is not None or not self.frontier:
             self._finish()
@@ -271,9 +271,8 @@ class _Lane:
         chain = []
         cur: Optional[int] = gidx
         while cur is not None:
-            py = interp.from_struct(
-                st.unpack(self.store.get(cur), self.lay, np),
-                self.config.bounds)
+            py = self.model.from_vec(self.store.get(cur),
+                                     self.config.bounds)
             entry = self.parents[cur]
             label = self.table[entry[1]].label() if entry else None
             chain.append((label, py))
@@ -286,14 +285,14 @@ class _Bin:
     """One step signature: a compiled fused step + the lanes sharing it."""
 
     def __init__(self, key: tuple, config: CheckConfig):
+        from raft_tla_tpu.frontend import resolve_model
         self.key = key
         self.bounds = config.bounds
-        self.lay = st.Layout.of(config.bounds)
-        self.table = S.action_table(config.bounds, config.spec)
+        self.model = resolve_model(config.spec)
+        self.lay = self.model.layout(config.bounds)
+        self.table = self.model.action_table(config.bounds)
         self.A = len(self.table)
-        self.step = jax.jit(kernels.build_step(
-            config.bounds, config.spec, tuple(config.invariants),
-            tuple(config.symmetry), view=config.view))
+        self.step = jax.jit(self.model.build_step(config))
         self.lanes: list[_Lane] = []
         self.rr = 0                     # round-robin fill offset
 
@@ -341,7 +340,8 @@ class BatchExecutor:
                 bn = bins[key] = _Bin(key, config)
             lane = _Lane(job_id, config, bn.table, bn.lay,
                          tel=telemetry.get(job_id),
-                         init_override=init_overrides.get(job_id))
+                         init_override=init_overrides.get(job_id),
+                         model=bn.model)
             bn.lanes.append(lane)
             lanes.append(lane)
             if not lane.active:         # init-state verdict, no dispatch

@@ -62,8 +62,16 @@ def resolve_check_config(cfg: TLCConfig, opts: JobOptions,
     spec), invariant/property resolution with did-you-mean, SYMMETRY
     axis mapping, CONSTRAINT/VIEW compatibility, and the Bounds build.
     """
+    from raft_tla_tpu.frontend import resolve_model
+    from raft_tla_tpu.frontend.predicate import is_expression
     from raft_tla_tpu.models import invariants as inv_mod
     from raft_tla_tpu.models import liveness as live_mod
+
+    model = resolve_model(opts.spec)     # ValueError on unknown spec name
+    if not model.is_raft:
+        # Non-Raft models own their cfg mapping (constants, invariant
+        # language, bounds) — one method, same (config, props) contract.
+        return model.resolve_check_config(cfg, opts, path)
 
     if cfg.specification not in (None, "Spec"):
         raise ValueError(
@@ -78,8 +86,21 @@ def resolve_check_config(cfg: TLCConfig, opts: JobOptions,
             "are compiled")
     # Unknown names fail at resolve time with the offending cfg line and
     # a did-you-mean (one resolver, shared with the Pass 2 lint).
-    cfgparse.resolve_names(cfg.invariants, inv_mod.REGISTRY, "invariant",
+    # Whole-line predicate EXPRESSIONS bypass the registry and must
+    # parse against the Raft state schema instead.
+    named = [nm for nm in cfg.invariants if not is_expression(nm)]
+    cfgparse.resolve_names(named, inv_mod.REGISTRY, "invariant",
                            cfg=cfg, path=path)
+    for nm in cfg.invariants:
+        if not is_expression(nm):
+            continue
+        try:
+            inv_mod._expression(nm)
+        except ValueError as e:
+            lineno = cfg.line_of("invariant", nm)
+            where = f"{path or 'cfg'} line {lineno}: " if lineno else ""
+            raise ValueError(
+                f"{where}invariant expression {nm!r} does not parse: {e}")
     for nm in cfg.properties:
         live_mod.parse_property(nm)     # raises with both registries
     sym_names = set(cfg.symmetry) | ({"Server"} if opts.symmetry else set())
@@ -231,14 +252,40 @@ def admit(job: CheckJob) -> Admission:
     resolve-time error.  The returned findings are the error payload.
     """
     from raft_tla_tpu.analysis import cfglint, widthcheck
+    from raft_tla_tpu.frontend import resolve_model
 
     opts = job.options
+    # Spec name first: an unknown spec must be a lint-style finding, not
+    # a traceback out of the queue worker.
+    try:
+        model = resolve_model(opts.spec)
+    except ValueError as e:
+        f = _report.Finding(_report.CFG, _report.ERROR, "spec-unknown",
+                            str(e), field=opts.spec)
+        return Admission(job, False, [f], reason="spec-unknown")
+
     try:
         cfg = cfgparse.parse_cfg(job.read_cfg_text())
     except (OSError, ValueError) as e:
         f = _report.Finding(_report.CFG, _report.ERROR, "cfg-unreadable",
                             str(e), file=job.cfg_path)
         return Admission(job, False, [f], reason="cfg-unreadable")
+
+    if not model.is_raft:
+        # Non-Raft admission: the model maps the cfg itself, then its
+        # schema validity gate plays the width-proof role.
+        try:
+            config, props = model.resolve_check_config(
+                cfg, opts, path=job.cfg_path)
+        except ValueError as e:
+            f = _report.Finding(_report.CFG, _report.ERROR,
+                                "resolve-failed", str(e), file=job.cfg_path)
+            return Admission(job, False, [f], reason="cfg-invalid")
+        findings = list(model.check_widths(config.bounds))
+        if _report.has_errors(findings):
+            return Admission(job, False, findings, reason="width-unsafe")
+        return Admission(job, True, findings, config=config,
+                         properties=props)
 
     try:
         bounds = Bounds(
@@ -253,15 +300,15 @@ def admit(job: CheckJob) -> Admission:
         f = _report.Finding(_report.WIDTH, _report.ERROR, "bounds-invalid",
                             str(e), file=job.cfg_path)
         findings = [f] + cfglint.lint_cfg(
-            cfg, Bounds(), spec=opts.spec, view=opts.view,
+            cfg, Bounds(), spec=model.sub, view=opts.view,
             path=job.cfg_path)
         return Admission(job, False, findings, reason="width-unsafe")
 
-    findings = list(widthcheck.check_widths(bounds, opts.spec))
+    findings = list(widthcheck.check_widths(bounds, model.sub))
     if _report.has_errors(findings):
         return Admission(job, False, findings, reason="width-unsafe")
 
-    findings += cfglint.lint_cfg(cfg, bounds, spec=opts.spec,
+    findings += cfglint.lint_cfg(cfg, bounds, spec=model.sub,
                                  view=opts.view, path=job.cfg_path)
     if _report.has_errors(findings):
         return Admission(job, False, findings, reason="cfg-invalid")

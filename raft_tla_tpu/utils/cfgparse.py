@@ -133,9 +133,19 @@ def parse_cfg(text: str) -> TLCConfig:
             cfg.next = line
             cfg.lines[("next", line)] = lineno
         elif mode in ("INVARIANT", "INVARIANTS"):
-            for name in line.split():
-                cfg.invariants.append(name)
-                cfg.lines[("invariant", name)] = lineno
+            # Bare registry names may share a line like stock TLC; any
+            # line that is NOT all bare identifiers is one whole-line
+            # predicate EXPRESSION (frontend/predicate.py grammar).
+            from raft_tla_tpu.frontend.predicate import is_expression
+            names = line.split()
+            if any(is_expression(nm) for nm in names):
+                text = " ".join(names)
+                cfg.invariants.append(text)
+                cfg.lines[("invariant", text)] = lineno
+            else:
+                for name in names:
+                    cfg.invariants.append(name)
+                    cfg.lines[("invariant", name)] = lineno
         elif mode in ("PROPERTY", "PROPERTIES"):
             # temporal FORMULAS (<>P, []<>P, P ~> Q) are one property
             # per line; bare names may share a line like INVARIANTS

@@ -107,15 +107,18 @@ def render_state(s, bounds: Bounds, indent: str = "    ") -> str:
     return "\n".join(indent + ln for ln in lines)
 
 
-def render_trace(violation, bounds: Bounds) -> str:
-    """TLC-style numbered counterexample trace."""
+def render_trace(violation, bounds: Bounds, state_renderer=None) -> str:
+    """TLC-style numbered counterexample trace.  ``state_renderer``
+    overrides the per-state formatter (non-Raft models supply their
+    own); the default is the Raft :func:`render_state`."""
     from raft_tla_tpu.models.refbfs import DEADLOCK
+    rs = state_renderer or render_state
     head = "Error: Deadlock reached." if violation.invariant == DEADLOCK \
         else f"Error: Invariant {violation.invariant} is violated."
     out = [head, "Error: The behavior up to this point is:"]
     for k, (label, state) in enumerate(violation.trace, start=1):
         head = "<Initial predicate>" if label is None else f"<{label}>"
         out.append(f"State {k}: {head}")
-        out.append(render_state(state, bounds))
+        out.append(rs(state, bounds))
         out.append("")
     return "\n".join(out)

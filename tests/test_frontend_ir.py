@@ -1,0 +1,203 @@
+"""frontend/raft_ir + widthgen: Raft as the IR compiler's first client.
+
+Two parity claims, each pinned bit-for-bit:
+
+- **Pass-1 twins**: ``widthgen.transfer_of`` derives the speclint
+  interval twins from the same ActionDefs the runtime kernels compile
+  from; they must equal the hand-written ``widthcheck.TRANSFERS``
+  output-for-output (writes, sends, AND the message-envelope fixpoint),
+  so the hand table and the kernels can only drift together.
+- **Runtime step**: the IR-compiled kernel table produces the same
+  states, fingerprints, invariant verdicts, and traces as the hand
+  kernels — at the step level (every output lane), the engine level
+  (the 3014-state toy), and on violation/deadlock traces.
+
+Heavy arms (the 583506-state from-init violation, the symmetry orbit
+sweeps) are marked slow; tier-1 keeps the seeded-violation and toy-bound
+arms only.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu import engine
+from raft_tla_tpu.analysis import intervals as iv
+from raft_tla_tpu.analysis import widthcheck as wc
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.frontend import raft_ir
+from raft_tla_tpu.models import interp
+from raft_tla_tpu.models import spec as S
+from raft_tla_tpu.ops import kernels
+from raft_tla_tpu.ops import msgbits as mb
+
+TOY = Bounds(n_servers=2, n_values=1, max_term=2, max_log=1, max_msgs=2)
+
+TWIN_BOUNDS = [
+    Bounds(),
+    Bounds(n_servers=2, n_values=1, max_term=2, max_log=1, max_msgs=2),
+    Bounds(n_servers=5, n_values=2, max_term=4, max_log=2, max_msgs=3,
+           max_dup=2),
+]
+
+
+# -- Pass-1 twin equality -----------------------------------------------------
+
+@pytest.mark.parametrize("bounds", TWIN_BOUNDS,
+                         ids=["default", "toy", "wide"])
+def test_generated_twins_equal_hand(bounds):
+    env = iv.expansion_envelope(bounds)
+    gen = raft_ir.transfers()
+    assert set(gen) == set(wc.TRANSFERS)
+    # the envelope fixpoint must agree BEFORE the per-family comparison:
+    # it feeds every Receive twin
+    menv_hand = wc.message_envelope(bounds, env, wc.TRANSFERS)
+    menv_gen = wc.message_envelope(bounds, env, gen)
+    assert menv_hand == menv_gen
+    for fam in wc.TRANSFERS:
+        hand = wc.TRANSFERS[fam](bounds, env, menv_hand)
+        made = gen[fam](bounds, env, menv_hand)
+        assert made.writes == hand.writes, fam
+        assert made.sends == hand.sends, fam
+
+
+@pytest.mark.parametrize("spec", ["full", "election", "replication"])
+def test_check_widths_clean_with_generated_twins(spec):
+    for bounds in TWIN_BOUNDS:
+        assert wc.check_widths(bounds, spec,
+                               transfers=raft_ir.transfers()) == [], spec
+
+
+# -- step-level bit identity --------------------------------------------------
+
+def test_step_bit_identical_on_toy_frontiers():
+    """Every output lane of the fused step — packed successors, valid/
+    overflow masks, both fingerprint words, invariant verdicts,
+    constraint flags — over two BFS levels from Init."""
+    import jax
+    invs = ("NoTwoLeaders",)
+    hand = jax.jit(kernels.build_step(TOY, "election", invariants=invs))
+    made = jax.jit(kernels.build_step(
+        TOY, "election", invariants=invs,
+        family_kernels=raft_ir.family_kernels(TOY)))
+    B = 16                     # fixed batch: one compile spans both levels
+    init = np.asarray(interp.to_vec(interp.init_state(TOY), TOY))
+    vecs = np.tile(init, (B, 1))
+    for level in range(2):
+        out_h = {k: np.asarray(v) for k, v in hand(vecs).items()}
+        out_m = {k: np.asarray(v) for k, v in made(vecs).items()}
+        assert set(out_h) == set(out_m)
+        for key in out_h:
+            assert np.array_equal(out_h[key], out_m[key]), (level, key)
+        keep = out_h["valid"] & ~out_h["overflow"]
+        nxt = np.unique(out_h["svecs"][keep], axis=0)
+        assert 0 < len(nxt) <= B
+        # pad back to B with repeats of the first successor
+        vecs = np.concatenate([nxt, np.tile(nxt[:1], (B - len(nxt), 1))])
+
+
+# -- engine-level parity ------------------------------------------------------
+
+def _pair(spec_bounds, **cfg_kw):
+    res = {}
+    for spec in ("election", "ir-election"):
+        cfg = CheckConfig(bounds=spec_bounds, spec=spec, **cfg_kw)
+        res[spec] = engine.check(cfg)
+    return res["election"], res["ir-election"]
+
+
+def test_engine_ir_equals_hand_on_toy():
+    hand, made = _pair(TOY, invariants=("NoTwoLeaders",), chunk=256)
+    assert (hand.n_states, hand.diameter, hand.n_transitions) == (
+        made.n_states, made.diameter, made.n_transitions)
+    assert hand.coverage == made.coverage
+    # the anchor itself, so a joint drift cannot hide
+    assert (hand.n_states, hand.diameter, hand.n_transitions) == \
+        (3014, 17, 5274)
+
+
+def bag(*ms):
+    return tuple(sorted((m, 1) for m in ms))
+
+
+@pytest.mark.slow
+def test_violation_trace_identical_seeded():
+    """Hand and IR reconstruct the SAME NaiveNoTwoLeaders counterexample
+    (labels and full states), from the cheap seeded start."""
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=4, max_dup=1)
+    start = interp.init_state(bounds)._replace(
+        role=(S.LEADER, S.FOLLOWER, S.CANDIDATE),
+        term=(2, 3, 3), votedFor=(1, 3, 0),
+        vGrant=(0b011, 0, 0b100),
+        msgs=bag(mb.rv_response(3, 1, 1, 2)))
+    out = {}
+    for spec in ("election", "ir-election"):
+        cfg = CheckConfig(bounds=bounds, spec=spec,
+                          invariants=("NaiveNoTwoLeaders",), chunk=256)
+        out[spec] = engine.check(cfg, init_override=start)
+    v_h, v_m = out["election"].violation, out["ir-election"].violation
+    assert v_h is not None and v_m is not None
+    assert v_h.invariant == v_m.invariant == "NaiveNoTwoLeaders"
+    assert v_h.state == v_m.state
+    assert v_h.trace == v_m.trace
+
+
+def test_deadlock_trace_identical():
+    """Replication from default Init deadlocks immediately (no client
+    request has happened, no AE is sendable) — both compilers must
+    report the same deadlock state and trace."""
+    out = {}
+    for spec in ("replication", "ir-replication"):
+        cfg = CheckConfig(bounds=TOY, spec=spec, invariants=(),
+                          check_deadlock=True, chunk=256)
+        out[spec] = engine.check(cfg)
+    v_h, v_m = out["replication"].violation, out["ir-replication"].violation
+    assert v_h is not None and v_m is not None
+    assert v_h.invariant == v_m.invariant
+    assert v_h.trace == v_m.trace
+    assert len(v_h.trace) == 1              # Init itself is the deadlock
+
+
+# -- heavy arms ---------------------------------------------------------------
+
+@pytest.mark.slow
+def test_violation_trace_identical_from_init():
+    """The full from-init search (583506 states) ends in the same
+    19-state counterexample under both compilers."""
+    bounds = Bounds(n_servers=3, n_values=1, max_term=3, max_log=0,
+                    max_msgs=1)
+    out = {}
+    for spec in ("election", "ir-election"):
+        cfg = CheckConfig(bounds=bounds, spec=spec,
+                          invariants=("NaiveNoTwoLeaders",), chunk=256)
+        out[spec] = engine.check(cfg)
+    r_h, r_m = out["election"], out["ir-election"]
+    assert r_h.n_states == r_m.n_states == 583506
+    v_h, v_m = r_h.violation, r_m.violation
+    assert v_h is not None and v_m is not None
+    assert len(v_h.trace) == 19
+    assert v_h.trace == v_m.trace
+
+
+_SYM_ARMS = [
+    # (bounds, |G|): Server orbit sizes 3! / 4! / 5!.  The |G|=120 arm
+    # runs at max_term=1 (a near-degenerate 2-state space) — it probes
+    # the 120-permutation orbit canonicalization, not search depth.
+    (Bounds(n_servers=3, n_values=1, max_term=2, max_log=0, max_msgs=1), 6),
+    (Bounds(n_servers=4, n_values=1, max_term=2, max_log=0, max_msgs=1), 24),
+    (Bounds(n_servers=5, n_values=1, max_term=1, max_log=0, max_msgs=1),
+     120),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bounds,order", _SYM_ARMS,
+                         ids=["G6", "G24", "G120"])
+def test_engine_ir_equals_hand_under_symmetry(bounds, order):
+    import math
+    assert math.factorial(bounds.n_servers) == order
+    hand, made = _pair(bounds, invariants=("NoTwoLeaders",),
+                       symmetry=("Server",), chunk=256)
+    assert (hand.n_states, hand.diameter, hand.n_transitions) == (
+        made.n_states, made.diameter, made.n_transitions)
+    assert hand.violation is None and made.violation is None

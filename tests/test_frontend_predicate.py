@@ -1,0 +1,225 @@
+"""frontend/predicate: the expression invariant compiler.
+
+Tier-1: pure parsing plus host-side evaluation over tiny structs and
+one Init state — no engine runs, no jit compiles beyond a single
+un-jitted jnp evaluation, so the whole file runs in seconds.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.analysis import cfglint
+from raft_tla_tpu.config import Bounds
+from raft_tla_tpu.frontend.predicate import (
+    compile_predicate, is_expression, parse)
+from raft_tla_tpu.models import interp
+from raft_tla_tpu.models import invariants as inv_mod
+from raft_tla_tpu.models import spec as S
+from raft_tla_tpu.ops import state as st
+from raft_tla_tpu.utils import cfgparse
+
+TOY = Bounds(n_servers=2, n_values=1, max_term=2, max_log=1, max_msgs=2)
+
+
+def _ev(text, struct=None, xp=np):
+    return bool(compile_predicate(text).ev(
+        {} if struct is None else struct, xp))
+
+
+# -- precedence & associativity ----------------------------------------------
+
+@pytest.mark.parametrize("text,want", [
+    # => is right-associative: F => (F => F) = F => TRUE = TRUE;
+    # the left-associative reading (F => F) => F would be FALSE.
+    ("FALSE => FALSE => FALSE", True),
+    # \/ binds tighter than =>: TRUE => (FALSE \/ FALSE) = FALSE.
+    ("TRUE => FALSE \\/ FALSE", False),
+    # /\ binds tighter than \/: TRUE \/ (FALSE /\ FALSE) = TRUE.
+    ("TRUE \\/ FALSE /\\ FALSE", True),
+    # ~ binds tighter than /\ but looser than comparisons:
+    # (~FALSE) /\ TRUE, and ~(1 = 2).
+    ("~FALSE /\\ TRUE", True),
+    ("~1 = 2", True),
+    # comparisons bind looser than +/-: (1 + 2) = (5 - 2).
+    ("1 + 2 = 5 - 2", True),
+    # * binds tighter than +: 1 + (2 * 3) = 7.
+    ("1 + 2 * 3 = 7", True),
+    # unary minus binds tighter than *: ((-2) * 3) = -(6).
+    ("-2 * 3 = -6", True),
+    ("2 - -1 = 3", True),
+    # parentheses override: (1 + 2) * 3 = 9.
+    ("(1 + 2) * 3 = 9", True),
+])
+def test_precedence(text, want):
+    assert _ev(text) is want
+
+
+def test_comparison_ops():
+    for text, want in [("1 /= 2", True), ("2 <= 2", True), ("3 < 3", False),
+                       ("3 >= 4", False), ("4 > 3", True), ("1 = 1", True)]:
+        assert _ev(text) is want
+
+
+# -- reducers and implicit universal quantification ---------------------------
+
+def test_reducers():
+    struct = {"x": np.array([0, 2, 3], dtype=np.int32)}
+    assert _ev("any(x = 2)", struct)
+    assert not _ev("all(x = 2)", struct)
+    assert _ev("count(x > 0) = 2", struct)
+    assert _ev("min(x) = 0 /\\ max(x) = 3", struct)
+
+
+def test_implicit_forall():
+    # A non-scalar boolean result is universally quantified at the top.
+    struct = {"x": np.array([1, 1], dtype=np.int32)}
+    assert _ev("x = 1", struct)
+    struct = {"x": np.array([1, 2], dtype=np.int32)}
+    assert not _ev("x = 1", struct)
+
+
+def test_indexing():
+    struct = {"x": np.array([4, 7], dtype=np.int32)}
+    assert _ev("x[1] = 7 /\\ x[1 - 1] = 4", struct)
+
+
+# -- dual backend -------------------------------------------------------------
+
+def test_numpy_jnp_agree():
+    import jax.numpy as jnp
+    struct_np = {"x": np.array([0, 2, 3], dtype=np.int32),
+                 "y": np.array([1, 1, 1], dtype=np.int32)}
+    struct_jnp = {k: jnp.asarray(v) for k, v in struct_np.items()}
+    for text in ("any(x = 2) => all(y = 1)", "count(x > 0) = 2",
+                 "min(x) + max(x) = 3", "~all(x = y)",
+                 "all(x <= 3) /\\ all(y >= 1)"):
+        pred = compile_predicate(text)
+        assert bool(pred.ev(struct_np, np)) == bool(pred.ev(struct_jnp, jnp))
+
+
+# -- compile-time diagnostics -------------------------------------------------
+
+def test_unknown_field_with_whitelist():
+    with pytest.raises(ValueError, match="unknown field 'bogus'"):
+        compile_predicate("bogus = 1", fields=("role", "term"))
+    # without a whitelist any NAME is accepted (resolves at probe time)
+    compile_predicate("bogus = 1")
+
+
+def test_arithmetic_rejected_as_invariant():
+    with pytest.raises(ValueError, match="arithmetic, not boolean"):
+        compile_predicate("1 + 1")
+
+
+def test_type_errors():
+    with pytest.raises(ValueError, match="needs a boolean"):
+        parse("~1")
+    with pytest.raises(ValueError, match="needs an integer"):
+        parse("TRUE + 1")
+    with pytest.raises(ValueError, match="trailing input"):
+        parse("1 = 1 1")
+    with pytest.raises(ValueError, match="syntax error"):
+        parse("1 = ")
+
+
+def test_is_expression():
+    assert not is_expression("NoTwoLeaders")
+    assert not is_expression("  SomeName  ")
+    assert is_expression("x = 1")
+    assert is_expression("all(commitIndex <= logLen)")
+    assert is_expression("~TRUE")
+
+
+def test_reads():
+    pred = compile_predicate("any(role = 2) => all(term <= commitIndex)")
+    assert pred.reads == frozenset({"role", "term", "commitIndex"})
+
+
+# -- width-boundary constants over the Raft schema ----------------------------
+
+# (field, in-range bound at TOY, one-past-max probe) — both must agree
+# through the py path (PyState -> to_vec -> unpack) and the jnp path.
+_BOUNDARY = [
+    ("role", "all(role <= 2)", "any(role > 2)"),
+    ("term", "all(term <= 2)", "any(term > 2)"),
+    ("votedFor", "all(votedFor <= 2)", "any(votedFor > 2)"),
+    ("commitIndex", "all(commitIndex <= 1)", "any(commitIndex > 1)"),
+    ("logLen", "all(logLen <= 1)", "any(logLen > 1)"),
+]
+
+
+@pytest.mark.parametrize("history",
+                         [pytest.param(False, id="parity"),
+                          pytest.param(True, id="faithful")])
+@pytest.mark.parametrize("field,at_max,past_max", _BOUNDARY)
+def test_width_boundary_both_encodings(history, field, at_max, past_max):
+    import jax.numpy as jnp
+    b = TOY if not history else Bounds(
+        n_servers=2, n_values=1, max_term=2, max_log=1, max_msgs=2,
+        history=True)
+    init = interp.init_state(b)
+    # py path: the registered-invariant probe shape
+    assert inv_mod.py_invariant(at_max)(init, b) is True
+    assert inv_mod.py_invariant(past_max)(init, b) is False
+    # jnp path: the vmapped device probe shape
+    lay = st.Layout.of(b)
+    struct = st.unpack(jnp.asarray(interp.to_vec(init, b)), lay, jnp)
+    assert bool(inv_mod.jnp_invariant(at_max, b)(struct)) is True
+    assert bool(inv_mod.jnp_invariant(past_max, b)(struct)) is False
+
+
+# -- cfg integration ----------------------------------------------------------
+
+_CFG = """\
+SPECIFICATION Spec
+CONSTANT Server = {s1, s2}
+CONSTANT Value = {v1}
+INVARIANT
+  NoTwoLeaders
+  all(commitIndex <= logLen)
+"""
+
+
+def test_cfgparse_whole_line_expression():
+    cfg = cfgparse.parse_cfg(_CFG)
+    assert "NoTwoLeaders" in cfg.invariants
+    assert "all(commitIndex <= logLen)" in cfg.invariants
+    assert cfg.line_of("invariant", "all(commitIndex <= logLen)") == 6
+
+
+def test_cfgparse_multi_name_line_stays_names():
+    # stock-TLC style: several registry names sharing one line must NOT
+    # be folded into one "expression" (the flagship cfg does this)
+    cfg = cfgparse.parse_cfg(
+        "INVARIANTS NoTwoLeaders LogMatching LeaderCompleteness\n")
+    assert cfg.invariants == ["NoTwoLeaders", "LogMatching",
+                              "LeaderCompleteness"]
+    assert cfg.line_of("invariant", "LogMatching") == 1
+
+
+def test_cfgparse_normalizes_whitespace():
+    cfg = cfgparse.parse_cfg(
+        "INVARIANT\n  all(  commitIndex   <= logLen )\n")
+    assert cfg.invariants == ["all( commitIndex <= logLen )"]
+
+
+def test_cfglint_expression_parse_error():
+    cfg = cfgparse.parse_cfg("SPECIFICATION Spec\n"
+                             "CONSTANT Server = {s1, s2}\n"
+                             "CONSTANT Value = {v1}\n"
+                             "INVARIANT\n  all(bogus = 1)\n")
+    codes = [f.code for f in cfglint.lint_cfg(cfg, TOY)]
+    assert "invariant-parse-error" in codes
+    assert "unknown-invariant" not in codes
+
+
+def test_cfglint_expression_vacuity():
+    # Nothing in the election subset writes commitIndex or logLen, and
+    # the predicate holds on Init — vacuous there, live under "full".
+    cfg = cfgparse.parse_cfg(_CFG)
+    election = cfglint.lint_cfg(cfg, TOY, spec="election")
+    assert [(f.code, f.field) for f in election
+            if f.code == "invariant-vacuous"] == \
+        [("invariant-vacuous", "all(commitIndex <= logLen)")]
+    full = cfglint.lint_cfg(cfg, TOY, spec="full")
+    assert [f for f in full if f.code == "invariant-vacuous"] == []

@@ -62,6 +62,18 @@ print(f"serve smoke ok: 2 jobs x {recs[0]['n_states']} states, "
       "per-tenant event logs valid")
 PY
 
+echo "== frontend smoke (two-phase commit through the spec compiler, CPU) =="
+cat > "$SERVE_TMP/2pc.cfg" <<'CFG'
+SPECIFICATION Spec
+CONSTANT RM = {r1, r2}
+INVARIANT TCConsistent
+CFG
+python -m raft_tla_tpu.check "$SERVE_TMP/2pc.cfg" \
+    --spec twophase --engine host --chunk 256 --cpu \
+    | tee "$SERVE_TMP/2pc.out" | tail -2
+grep -q "^56 distinct states found" "$SERVE_TMP/2pc.out" \
+    || { echo "frontend smoke FAILED: expected 56 states"; exit 1; }
+
 echo "== megakernel smoke (toy cfg, staged whole-step Pallas, CPU) =="
 # Gate forced ON: off-TPU this runs the kernel in Pallas interpret
 # mode (ops/pallas_compat.resolve), so the block walks the real
