@@ -47,6 +47,7 @@ DEFAULT_TARGETS = (
     "raft_tla_tpu/parallel",
     "raft_tla_tpu/obs",
     "raft_tla_tpu/serve",
+    "raft_tla_tpu/campaign",
     "raft_tla_tpu/frontend",
 )
 

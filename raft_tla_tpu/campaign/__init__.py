@@ -1,0 +1,26 @@
+"""campaign/ — preemption-tolerant campaign supervision.
+
+A campaign outlives any single process: the :class:`Supervisor` spawns
+``raft_tla_tpu.check`` children it is allowed to lose, watches their
+event logs for unhealth, drives the lossless-stop contract, verifies
+every snapshot before resuming it (quarantining corrupt families —
+never the same poison twice), reshards between mesh sizes as the
+allocation changes, and retries with bounded backoff until the check
+reaches a verdict.  :mod:`~raft_tla_tpu.campaign.chaos` is the fault
+harness that proves all of it: kill, truncate, shrink, grow — finals
+identical to an uninterrupted run.
+"""
+
+from raft_tla_tpu.campaign.integrity import (  # noqa: F401
+    CheckpointCorrupt,
+    snapshot_family,
+    verify_snapshot,
+)
+from raft_tla_tpu.campaign.supervisor import (  # noqa: F401
+    CampaignPolicy,
+    CampaignResult,
+    CampaignSpec,
+    HealthMonitor,
+    Supervisor,
+    fit_mesh,
+)

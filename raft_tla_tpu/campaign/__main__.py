@@ -1,0 +1,6 @@
+"""``python -m raft_tla_tpu.campaign`` == ``raft-tla-campaign``."""
+
+from raft_tla_tpu.campaign.cli import entry
+
+if __name__ == "__main__":
+    entry()

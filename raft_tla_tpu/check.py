@@ -22,6 +22,7 @@ jit, host dedup), ``ref`` (pure-Python oracle BFS).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -30,6 +31,8 @@ EXIT_DEADLOCK = 11       # TLC's exit code for deadlock
 EXIT_VIOLATION = 12      # TLC's exit code for safety-property violations
 EXIT_LIVENESS = 13       # TLC's exit code for liveness-property violations
 EXIT_ERROR = 1
+EXIT_STOPPED = 14        # ours: stopped before exhaustion (resumable) —
+#                          no verdict; the campaign supervisor keys on it
 
 
 def build_argparser() -> argparse.ArgumentParser:
@@ -189,6 +192,12 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--resume", metavar="PATH",
                    help="resume a --checkpoint snapshot (device/paged/"
                         "shard engines)")
+    p.add_argument("--deadline", type=float, default=None,
+                   metavar="SECONDS",
+                   help="stop losslessly at the first segment boundary "
+                        "past this wall budget (exit 14, snapshot "
+                        "flushed; --engine ddd only) — the campaign "
+                        "supervisor's session-wall policy knob")
     p.add_argument("--no-trace", action="store_true",
                    help="suppress the counterexample trace on violation")
     p.add_argument("--coverage", action="store_true",
@@ -343,7 +352,18 @@ def _force_cpu(args):
     try:
         jax.config.update("jax_platforms", "cpu")
         if args.devices:
-            jax.config.update("jax_num_cpu_devices", args.devices)
+            try:
+                jax.config.update("jax_num_cpu_devices", args.devices)
+            except AttributeError:
+                # older jax: no jax_num_cpu_devices knob — the XLA flag
+                # does the same job as long as no backend is live yet
+                # (same caveat the RuntimeError arm below covers)
+                flags = [f for f in os.environ.get("XLA_FLAGS",
+                                                   "").split()
+                         if "host_platform_device_count" not in f]
+                flags.append("--xla_force_host_platform_device_count="
+                             f"{args.devices}")
+                os.environ["XLA_FLAGS"] = " ".join(flags)
     except RuntimeError:
         if jax.default_backend() != "cpu":
             print("Warning: --cpu requested but JAX backends are "
@@ -408,7 +428,8 @@ def _run(args, config):
         return eng.check(on_progress=_stats_cb(args),
                          checkpoint=args.checkpoint,
                          checkpoint_every_s=args.checkpoint_every,
-                         resume=args.resume)
+                         resume=args.resume,
+                         deadline_s=args.deadline)
     if args.engine == "ddd-shard":
         from raft_tla_tpu.models import spec as S
         from raft_tla_tpu.parallel.ddd_shard_engine import (
@@ -493,6 +514,11 @@ def _finish_run(args, p, config, props, model, b) -> int:
         for fam, cnt in sorted(result.coverage.items()):
             print(f"  {fam}: {cnt} new states")
     if result.violation is None:
+        if not result.complete:
+            print("Model checking stopped before completion (state space "
+                  "not exhausted); resume from the checkpoint to "
+                  "continue.")
+            return EXIT_STOPPED
         print("Model checking completed. No error has been found.")
         return EXIT_OK
     from raft_tla_tpu.engine import DEADLOCK
@@ -545,6 +571,10 @@ def main(argv=None) -> int:
         p.error(f"--checkpoint/--resume require a device-class engine "
                 f"(got {args.engine}); other engines would silently "
                 "ignore them")
+    if args.deadline is not None and args.engine != "ddd":
+        p.error(f"--deadline requires --engine ddd (got {args.engine}); "
+                "only the ddd engine stops losslessly at a segment "
+                "boundary — dropping it silently would run unbounded")
     if args.stats and args.engine not in _DEVICE_ENGINES:
         p.error(f"--stats requires a device-class engine "
                 f"(got {args.engine})")
@@ -765,6 +795,13 @@ def main(argv=None) -> int:
         for fam, cnt in sorted(result.coverage.items()):
             print(f"  {fam}: {cnt} new states")
 
+    if result.violation is None and not result.complete:
+        # A lossless stop (SIGINT, --deadline, capacity policy): no
+        # verdict was reached, so neither "no error found" nor liveness
+        # (which needs the full graph) may be claimed.
+        print("Model checking stopped before completion (state space "
+              "not exhausted); resume from the checkpoint to continue.")
+        return EXIT_STOPPED
     if result.violation is None and props:
         code = _check_liveness(args, config, props)
         if code != EXIT_OK:

@@ -1166,12 +1166,14 @@ class DDDEngine:
                 coverage=dict(aggregate_coverage(self.table, cov)),
                 route_peak=route_peak)
 
+        n_trans_mark = n_trans   # n_trans as of the current block's start
         while not stopped:
             lvl_lo = level_ends[-2] if len(level_ends) > 1 else 0
             lvl_hi = level_ends[-1]
             for b_start in range(lvl_lo + blocks_done * Fcap, lvl_hi,
                                  Fcap):
                 b_rows = min(Fcap, lvl_hi - b_start)
+                n_trans_mark = n_trans
                 with tel.phases.phase("upload") as ph:
                     blk = host.read(b_start, b_rows)
                     con = constore.read(b_start, b_rows)[:, 0].astype(bool)
@@ -1353,14 +1355,19 @@ class DDDEngine:
         with tel.phases.phase("dedup"):
             n_states += self._flush(pend, master, host, constore, keystore,
                                     cov)
-        if self._sigint and checkpoint and not viol and not fail:
-            # graceful SIGINT stop: same mid-level snapshot shape as the
-            # periodic path above (pend flushed first, so re-running the
-            # partial block on resume dedups against the master keys)
+        if not complete and checkpoint and not viol and not fail:
+            # graceful stop (SIGINT or deadline): same mid-level snapshot
+            # shape as the periodic path above (pend flushed first, so
+            # re-running the partial block on resume dedups against the
+            # master keys) — a deadline stop must be as lossless as a
+            # SIGINT one or --deadline silently discards work.  The
+            # snapshot records n_trans as of the partial block's START:
+            # states dedup on the re-run, transitions do not, so counting
+            # any of the partial block here would double them on resume.
             with tel.phases.phase("snapshot"):
                 self.save_checkpoint(checkpoint, host, constore, keystore,
-                                     n_states, n_trans, cov, level_ends,
-                                     blocks_done, (hi0, lo0))
+                                     n_states, n_trans_mark, cov,
+                                     level_ends, blocks_done, (hi0, lo0))
             tel.checkpoint(checkpoint, n_states)
         if fail:
             _cleanup.close()

@@ -25,9 +25,9 @@ wall).  This module replaces all of that with:
   ``level_end`` derived automatically from level transitions and
   ``violation`` derived from the final :class:`~raft_tla_tpu.engine.EngineResult`.
 
-Event grammar (``SCHEMA_VERSION`` = 1) — every line is one JSON object
-with base fields ``v`` (schema version), ``event`` (type) and ``ts``
-(unix epoch seconds):
+Event grammar (``SCHEMA_VERSION`` = 2; version-1 lines remain valid) —
+every line is one JSON object with base fields ``v`` (schema version),
+``event`` (type) and ``ts`` (unix epoch seconds):
 
 ``run_start``      engine, universe, spec, invariants, resumed
                    [+ bounds, symmetry, view, chunk, caps, n_states,
@@ -40,9 +40,19 @@ with base fields ``v`` (schema version), ``event`` (type) and ``ts``
 ``run_end``        n_states, n_transitions, complete, outcome
                    [+ diameter, levels, wall_s]
 
+Version 2 adds the campaign-supervisor lifecycle (emitted by
+``raft_tla_tpu/campaign``, never by the engines themselves):
+
+``preempt``        reason [+ detail, pid, stale_s, drift]
+                   (the supervisor declared the child unhealthy / got a
+                    preemption signal and is driving the lossless stop)
+``reshard``        ndev_src, ndev_dst [+ n_states, path, block]
+``resume_attempt`` attempt [+ path, ndev, backoff_s, quarantined]
+
 A run log with no ``run_end`` means the process died — crash attribution
-for free.  The schema is strict: unknown fields fail validation, so any
-addition requires a version bump (versioning policy in README.md).
+for free.  The schema is strict: unknown fields fail validation and the
+v2-only event types are invalid on a ``"v": 1`` line, so any addition
+requires a version bump (versioning policy in README.md).
 """
 
 from __future__ import annotations
@@ -55,7 +65,8 @@ import subprocess
 import threading
 import time
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+_VERSIONS = (1, 2)           # versions validate_event accepts
 
 # Environment knobs (set by check.py --events/--phase-timers; inherited by
 # liveness re-runs and bench children the same way RAFT_TLA_SIGPRUNE is).
@@ -106,7 +117,14 @@ _REQUIRED = {
     "stop_requested": {"reason": str},
     "run_end": {"n_states": int, "n_transitions": int, "complete": bool,
                 "outcome": str},
+    "preempt": {"reason": str},
+    "reshard": {"ndev_src": int, "ndev_dst": int},
+    "resume_attempt": {"attempt": int},
 }
+
+# Event types that only exist from schema version 2 on (the campaign
+# supervisor lifecycle) — invalid on a "v": 1 line.
+_V2_EVENTS = frozenset({"preempt", "reshard", "resume_attempt"})
 
 _OPTIONAL = {
     "run_start": {"bounds": dict, "symmetry": list, "view": str,
@@ -120,6 +138,11 @@ _OPTIONAL = {
     "violation": {"kind": str},
     "stop_requested": {"source": str, "pid": int},
     "run_end": {"diameter": int, "levels": list, "wall_s": _NUM},
+    "preempt": {"detail": str, "pid": int, "stale_s": _NUM,
+                "drift": dict},
+    "reshard": {"n_states": int, "path": str, "block": int},
+    "resume_attempt": {"path": str, "ndev": int, "backoff_s": _NUM,
+                       "quarantined": str},
 }
 
 
@@ -140,11 +163,13 @@ def validate_event(d: dict) -> list:
             errs.append(f"base field {k!r} has wrong type")
     if errs:
         return errs
-    if d["v"] != SCHEMA_VERSION:
-        errs.append(f"schema version {d['v']} != {SCHEMA_VERSION}")
+    if d["v"] not in _VERSIONS:
+        errs.append(f"schema version {d['v']} not in {list(_VERSIONS)}")
     ev = d["event"]
     if ev not in _REQUIRED:
         return errs + [f"unknown event type {ev!r}"]
+    if ev in _V2_EVENTS and d["v"] in _VERSIONS and d["v"] < 2:
+        errs.append(f"{ev}: event type requires schema version >= 2")
     req, opt = _REQUIRED[ev], _OPTIONAL[ev]
     for k, spec in req.items():
         if k not in d:

@@ -26,8 +26,12 @@ Timeline normalisation (formerly campaign_projection.load):
 The heartbeat shows: level, states, incremental rate (trailing window),
 ETA (to ``--target``, else to end-of-level from the frontier trend),
 phase breakdown (when ``--phase-timers`` ran), fiducial drift vs the
-first ``run_start``, and the end-state attribution (``run_end`` outcome,
-``stop_requested`` reason, or "no run_end" = live or crashed).
+first ``run_start``, heartbeat staleness (time since the last event vs
+the run's own segment cadence), and the end-state attribution: a
+``run_end`` outcome; else "live" when events are still arriving on
+cadence; else "presumed-crashed" when the log has gone stale without a
+``run_end`` (the crash signature); else "live?" when the stream carries
+no timestamps to judge by (legacy .stats).
 """
 
 from __future__ import annotations
@@ -167,8 +171,37 @@ def _eta_s(summary: dict) -> float | None:
     return max(0.0, projected - done_in_level) / inc
 
 
+def _staleness(events: list, now: float,
+               stale_after_s: float | None) -> tuple:
+    """(last_event_age_s, segment_cadence_s, stale) for a timeline.
+
+    ``stale`` is a tri-state: True/False when the stream carries wall
+    timestamps to judge by, None when it does not (legacy .stats lines
+    have ``ts: None`` — no basis for a verdict).  The threshold is
+    ``stale_after_s`` when given, else derived from the run's OWN recent
+    segment cadence (10x the median inter-segment gap, clamped to
+    [30s, 1h]) so a slow deep level is not misread as a hang, falling
+    back to 300s when fewer than two timestamped segments exist.
+    """
+    stamped = [e["ts"] for e in events if e.get("ts") is not None]
+    if not stamped:
+        return None, None, None
+    age = max(0.0, now - stamped[-1])
+    seg_ts = [e["ts"] for e in events
+              if e["event"] == "segment" and e.get("ts") is not None]
+    tail = seg_ts[-9:]
+    gaps = sorted(g for g in
+                  (b - a for a, b in zip(tail, tail[1:])) if g >= 0)
+    cadence = gaps[len(gaps) // 2] if gaps else None
+    if stale_after_s is None:
+        stale_after_s = (min(3600.0, max(30.0, 10.0 * cadence))
+                         if cadence is not None else 300.0)
+    return age, cadence, age > stale_after_s
+
+
 def summarize(stream: dict, window_s: float = 600.0,
-              target: int | None = None) -> dict | None:
+              target: int | None = None, now: float | None = None,
+              stale_after_s: float | None = None) -> dict | None:
     """Distil a loaded stream into the heartbeat fields (None = no data)."""
     segments = stream["segments"]
     events = stream["events"]
@@ -211,17 +244,30 @@ def summarize(stream: dict, window_s: float = 600.0,
                 drift[key] = last[key] / first[key]
     summary["fiducial_drift"] = drift
 
+    # heartbeat staleness: time since the last event vs segment cadence
+    age, cadence, stale = _staleness(
+        events, time.time() if now is None else now, stale_after_s)
+    summary["last_event_age_s"] = age
+    summary["segment_cadence_s"] = cadence
+    summary["stale"] = stale
+
     # end-state attribution
-    status = "live?"  # no run_end yet: still running, or crashed
+    status = "live?"  # no run_end and no timestamps: can't judge
     for e in events:
         if e["event"] == "stop_requested":
             status = f"stop requested ({e['reason']})"
     for e in events:
         if e["event"] == "violation":
             status = f"VIOLATION {e['invariant']}"
-    for e in events:
-        if e["event"] == "run_end":
-            status = e["outcome"]
+    ended = any(e["event"] == "run_end" for e in events)
+    if ended:
+        status = [e for e in events if e["event"] == "run_end"][-1]["outcome"]
+    elif stale:
+        # the crash signature: the log went quiet without a run_end
+        cad = f", cadence ~{cadence:.0f}s" if cadence is not None else ""
+        status = f"presumed-crashed (last event {age:.0f}s ago{cad})"
+    elif stale is False:
+        status = f"live ({status})" if status != "live?" else "live"
     summary["status"] = status
     return summary
 
@@ -257,6 +303,8 @@ def heartbeat(summary: dict | None) -> str:
             parts.append(f"{short} drift {summary['fiducial_drift'][key]:.2f}x")
     if summary.get("route_peak") is not None:
         parts.append(f"route_peak {summary['route_peak']}")
+    if summary.get("last_event_age_s") is not None:
+        parts.append(f"last ev {summary['last_event_age_s']:.0f}s ago")
     parts.append(summary["status"])
     line = " | ".join(parts)
     if summary["n_invalid"]:
@@ -281,6 +329,11 @@ def main(argv=None) -> int:
                    help="trailing window for the incremental rate (s)")
     p.add_argument("--target", type=int, default=None,
                    help="ETA to this state count instead of end-of-level")
+    p.add_argument("--stale-after", type=float, default=None,
+                   help="flag the run presumed-crashed when the last "
+                        "event is older than this many seconds and no "
+                        "run_end was written (default: 10x the run's "
+                        "own segment cadence)")
     p.add_argument("--json", action="store_true",
                    help="print the full summary as JSON instead")
     args = p.parse_args(argv)
@@ -293,7 +346,8 @@ def main(argv=None) -> int:
             stream = None
         if stream is not None:
             summary = summarize(stream, window_s=args.window,
-                                target=args.target)
+                                target=args.target,
+                                stale_after_s=args.stale_after)
             if args.json:
                 print(json.dumps(summary, default=str), flush=True)
             else:

@@ -749,7 +749,7 @@ def reshard_checkpoint(config: CheckConfig, caps_src: ShardCapacities,
     hi0, lo0 = sym_mod.init_fingerprint(config, init_py, init_vec)
     init_key = (int(hi0), int(lo0))
 
-    with np.load(src_path) as z:
+    with ckpt.load_npz_verified(src_path) as z:
         arrs = [np.asarray(z[f"c{i}"])
                 for i in range(len(SCarry._fields))]
         stored_digest = int(z["config_digest"])

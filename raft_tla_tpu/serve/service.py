@@ -6,7 +6,7 @@ speclint gate (``jobs.admit``), run all admitted jobs through the
 lane-packed :class:`~raft_tla_tpu.serve.batch.BatchExecutor`, and leave
 behind per-tenant artifacts:
 
-- ``OUT/<job_id>.events`` — one obs/ SCHEMA_VERSION=1 event log per job,
+- ``OUT/<job_id>.events`` — one obs/ versioned event log per job,
   so ``raft-tla-monitor OUT/<job_id>.events`` renders any tenant's run
   unchanged.  Rejected jobs get a three-event log (``run_start``,
   ``stop_requested`` with the admission reason, ``run_end`` outcome
@@ -38,10 +38,18 @@ import time
 _JOB_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 
 
-def load_jobs(source: str) -> list:
+def load_jobs(source: str, skipped: list | None = None) -> list:
     """Read :class:`CheckJob` entries from a JSONL manifest file or a
     queue directory of ``*.json`` job files (sorted name order — the
     queue convention: producers write ``NNN-name.json``).
+
+    Queue-dir intake is race-tolerant: a producer writing a job file the
+    moment the service scans the directory must not poison the whole
+    pass, so a file that fails to read or parse gets one short-delay
+    retry and is then SKIPPED (recorded as ``(name, error)`` in the
+    optional ``skipped`` list) while the rest of the queue proceeds.
+    Manifest files stay strict — a manifest is one artifact written by
+    one producer, so a bad line is a bad manifest.
 
     Job ids must be path-safe (``[A-Za-z0-9._-]``, no leading dot) since
     they name the per-tenant event logs; duplicates are a hard error —
@@ -56,8 +64,25 @@ def load_jobs(source: str) -> list:
         if not names:
             raise ValueError(f"queue directory {source!r} has no *.json jobs")
         for n in names:
-            with open(os.path.join(source, n), "r", encoding="utf-8") as f:
-                entries.append((n[:-len(".json")], json.load(f)))
+            path = os.path.join(source, n)
+            d = None
+            for attempt in (0, 1):
+                try:
+                    with open(path, "r", encoding="utf-8") as f:
+                        d = json.load(f)
+                    break
+                except (OSError, ValueError) as e:
+                    if attempt:             # second failure: skip, not fail
+                        if skipped is not None:
+                            skipped.append((n, str(e)))
+                    else:
+                        time.sleep(0.05)    # writer may be mid-write
+            if d is not None:
+                entries.append((n[:-len(".json")], d))
+        if not entries:
+            raise ValueError(
+                f"queue directory {source!r}: all {len(names)} job "
+                "file(s) unreadable")
     else:
         with open(source, "r", encoding="utf-8") as f:
             for lineno, line in enumerate(f, 1):
@@ -254,11 +279,15 @@ def main(argv=None) -> int:
                 print("Warning: --cpu requested but JAX backends are "
                       f"already initialized on {jax.default_backend()!r}; "
                       "proceeding there", file=sys.stderr)
+    skipped: list = []
     try:
-        jobs = load_jobs(args.source)
+        jobs = load_jobs(args.source, skipped=skipped)
     except (OSError, ValueError) as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
+    for name, err in skipped:
+        print(f"Warning: skipped unreadable job file {name}: {err}",
+              file=sys.stderr)
     records = run_service(jobs, args.out, chunk=args.chunk,
                           max_states=args.max_states, quiet=args.quiet)
     n_by = {}

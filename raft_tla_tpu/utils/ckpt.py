@@ -21,10 +21,22 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import os
+import zipfile
 
 import numpy as np
 
 _STREAM_ROWS = 1 << 20      # rows per streamed block
+
+
+class CheckpointCorrupt(ValueError):
+    """A checkpoint file is truncated, torn, or fails its content digest.
+
+    Subclasses :class:`ValueError` so the engines' existing resume guards
+    (and anything matching their messages) keep working unchanged, while
+    a campaign supervisor can catch this type specifically and QUARANTINE
+    the snapshot instead of retrying it — a corrupt file never
+    deserializes into garbage state, and never gets resumed twice.
+    """
 
 
 def _stable(obj):
@@ -62,7 +74,24 @@ def config_digest(config, caps, init_key: tuple) -> int:
     return int.from_bytes(hashlib.sha256(key).digest()[:8], "big")
 
 
+def content_digest(arrays) -> str:
+    """Order-independent sha256 over every array's name, dtype, shape and
+    bytes — the integrity seal :func:`atomic_savez` embeds (under the
+    reserved key ``content_sha``) and :func:`load_npz_checked` verifies.
+    Distinct from :func:`config_digest`, which pins model *identity*: a
+    config mismatch is a caller error, a content mismatch is corruption."""
+    h = hashlib.sha256()
+    for k in sorted(arrays):
+        a = np.asarray(arrays[k])
+        h.update(k.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
 def atomic_savez(path: str, **arrays) -> None:
+    arrays["content_sha"] = content_digest(arrays)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:      # file handle: savez adds no suffix
         np.savez(f, **arrays)
@@ -71,10 +100,67 @@ def atomic_savez(path: str, **arrays) -> None:
     os.replace(tmp, path)
 
 
+def load_npz_verified(path: str):
+    """``np.load`` with corruption classified, content digest verified,
+    but NO config-digest comparison — for callers that derive the
+    expected config digest from the file's own contents (resharders) or
+    only need integrity (the campaign supervisor's snapshot verifier).
+
+    Raises :class:`CheckpointCorrupt` (naming the file) when the archive
+    is unreadable or fails its embedded content digest.  Snapshots
+    predating the embedded digest (no ``content_sha`` key) still load;
+    they simply get only the structural zip checks.
+    """
+    try:
+        z = np.load(path)
+    except FileNotFoundError:
+        raise
+    except (OSError, EOFError, ValueError, zipfile.BadZipFile) as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is not a readable npz archive ({e}) — "
+            "truncated or corrupt snapshot") from e
+    try:
+        names = set(z.files)
+        if "content_sha" in names:
+            want = str(z["content_sha"])
+            got = content_digest(
+                {k: z[k] for k in names if k != "content_sha"})
+            if got != want:
+                z.close()
+                raise CheckpointCorrupt(
+                    f"checkpoint {path} failed its content digest "
+                    f"(embedded {want[:12]}.., computed {got[:12]}..) — "
+                    "truncated or corrupt snapshot")
+    except CheckpointCorrupt:
+        raise
+    except (KeyError, OSError, EOFError, ValueError,
+            zipfile.BadZipFile) as e:
+        z.close()
+        raise CheckpointCorrupt(
+            f"checkpoint {path} could not be decoded ({e}) — truncated "
+            "or corrupt snapshot") from e
+    return z
+
+
 def load_npz_checked(path: str, digest: int):
-    """Returns the opened NpzFile; raises if the digest does not match."""
-    z = np.load(path)
-    if int(z["config_digest"]) != digest:
+    """Returns the opened NpzFile.
+
+    Raises :class:`CheckpointCorrupt` (naming the file) when the archive
+    is unreadable or fails its embedded content digest, and a plain
+    :class:`ValueError` when it is intact but belongs to a different
+    model config — the two must stay distinguishable: a supervisor
+    quarantines the former and refuses the latter.
+    """
+    z = load_npz_verified(path)
+    try:
+        cfg_digest = int(z["config_digest"])
+    except (KeyError, OSError, EOFError, ValueError,
+            zipfile.BadZipFile) as e:
+        z.close()
+        raise CheckpointCorrupt(
+            f"checkpoint {path} could not be decoded ({e}) — truncated "
+            "or corrupt snapshot") from e
+    if cfg_digest != digest:
         z.close()
         raise ValueError(
             "checkpoint was written under a different model config or "
@@ -156,7 +242,7 @@ def stream_width(path: str) -> int:
     with open(path, "rb") as f:
         hdr = np.fromfile(f, np.int64, 2)
     if hdr.shape[0] != 2:
-        raise ValueError(f"stream {path}: truncated header")
+        raise CheckpointCorrupt(f"stream {path}: truncated header")
     return int(hdr[1])
 
 
@@ -214,22 +300,27 @@ def stream_rows_in(path: str, writer, limit: int,
     corrupted rows.
     """
     with open(path, "rb") as f:
-        n_rows, width = (int(x) for x in np.fromfile(f, np.int64, 2))
+        hdr = np.fromfile(f, np.int64, 2)
+        if hdr.shape[0] != 2:
+            raise CheckpointCorrupt(f"stream {path}: truncated header")
+        n_rows, width = (int(x) for x in hdr)
         if expect_width is not None and width != expect_width:
             raise ValueError(
                 f"checkpoint stream {path} has row width {width}, this "
                 f"build expects {expect_width} — the packed-row layout "
                 "changed; the snapshot cannot be resumed")
         if n_rows < limit:
-            raise ValueError(
+            raise CheckpointCorrupt(
                 f"checkpoint stream {path} holds {n_rows} rows, "
                 f"metadata expects {limit} — torn snapshot")
         start = 0
         while start < limit:
             n = min(_STREAM_ROWS, limit - start)
-            block = np.fromfile(f, np.int32, n * width).reshape(n, width)
-            if block.shape[0] != n:
-                raise ValueError(f"truncated checkpoint stream {path}")
+            raw = np.fromfile(f, np.int32, n * width)
+            if raw.shape[0] != n * width:
+                raise CheckpointCorrupt(
+                    f"truncated checkpoint stream {path}")
+            block = raw.reshape(n, width)
             writer(block)
             start += n
     return limit

@@ -138,9 +138,27 @@ def test_validate_rejects_unknowns_and_type_drift():
     assert validate_event({**ok, "extra": 1})               # unknown field
     assert validate_event({**ok, "level": "3"})             # type drift
     assert validate_event({**ok, "level": True})            # bool is not int
-    assert validate_event({**ok, "v": 2})                   # version bump
+    assert validate_event({**ok, "v": 2}) == []             # v2 superset
+    assert validate_event({**ok, "v": 3})                   # future version
     assert validate_event({"v": 1, "event": "level_end", "ts": 0.0,
                            "level": 3})                     # missing field
+
+
+def test_validate_v2_supervisor_events():
+    ok = {"v": 2, "event": "preempt", "ts": 0.0, "reason": "stale"}
+    assert validate_event(ok) == []
+    assert validate_event({**ok, "stale_s": 12.5, "pid": 7}) == []
+    assert validate_event({**ok, "v": 1})      # v2-only type on a v1 line
+    assert validate_event({"v": 2, "event": "reshard", "ts": 0.0,
+                           "ndev_src": 8, "ndev_dst": 2,
+                           "n_states": 3014}) == []
+    assert validate_event({"v": 2, "event": "reshard", "ts": 0.0,
+                           "ndev_src": 8})     # missing ndev_dst
+    assert validate_event({"v": 2, "event": "resume_attempt", "ts": 0.0,
+                           "attempt": 1, "backoff_s": 0.5,
+                           "quarantined": "x.ckpt"}) == []
+    assert validate_event({"v": 2, "event": "resume_attempt", "ts": 0.0,
+                           "attempt": 1, "surprise": 1})    # unknown field
 
 
 def test_append_event_validates(tmp_path):
@@ -303,3 +321,80 @@ def test_obs_emit_cli_interleaves_with_log(tmp_path):
         [sys.executable, "-m", "raft_tla_tpu.obs", "emit", p, "bogus"],
         capture_output=True, text=True)
     assert bad.returncode != 0 and len(_read_log(p)) == 2
+
+
+# -- monitor end-state attribution (campaign supervision satellite) ---------
+# One test per status path in monitor.summarize: the supervisor's
+# health verdicts and the operator's heartbeat must agree on what a
+# quiet log means.
+
+
+def _seg(path, ts, n_states, level=1):
+    append_event(path, "segment", ts=ts, wall_s=ts, n_states=n_states,
+                 level=level, n_transitions=2 * n_states,
+                 dedup_hit_rate=0.5, states_per_sec=10.0,
+                 inc_states_per_sec=10.0, since_resume=True)
+
+
+def _summary(path, now, stale_after_s=None):
+    return monitor.summarize(monitor.load_stream(path), now=now,
+                             stale_after_s=stale_after_s)
+
+
+def test_monitor_attribution_run_end_wins(tmp_path):
+    p = str(tmp_path / "e")
+    _seg(p, 10.0, 100)
+    append_event(p, "run_end", ts=11.0, n_states=3014,
+                 n_transitions=5274, complete=True, outcome="ok")
+    # a finished run is never "presumed-crashed", however old the log
+    s = _summary(p, now=11.0 + 9999.0)
+    assert s["status"] == "ok"
+
+
+def test_monitor_attribution_presumed_crashed(tmp_path):
+    p = str(tmp_path / "e")
+    # 5s cadence -> auto threshold 10x = 50s (clamped to [30s, 1h])
+    for t in range(0, 30, 5):
+        _seg(p, float(t), 10 * (t + 1))
+    assert _summary(p, now=25.0 + 49.0)["status"] == "live"
+    s = _summary(p, now=25.0 + 51.0)
+    assert s["stale"] is True
+    assert s["status"].startswith("presumed-crashed (last event 51s ago")
+    assert "cadence ~5s" in s["status"]
+
+
+def test_monitor_attribution_explicit_threshold_overrides(tmp_path):
+    p = str(tmp_path / "e")
+    for t in range(0, 30, 5):
+        _seg(p, float(t), 10 * (t + 1))
+    # 49s of silence: live under the cadence rule, crashed at 10s policy
+    assert _summary(p, now=74.0)["status"] == "live"
+    s = _summary(p, now=74.0, stale_after_s=10.0)
+    assert s["status"].startswith("presumed-crashed")
+
+
+def test_monitor_attribution_stop_requested_live(tmp_path):
+    p = str(tmp_path / "e")
+    _seg(p, 10.0, 100)
+    append_event(p, "stop_requested", ts=11.0, reason="preempt",
+                 source="supervisor")
+    s = _summary(p, now=12.0)
+    assert s["status"] == "live (stop requested (preempt))"
+
+
+def test_monitor_attribution_violation_live(tmp_path):
+    p = str(tmp_path / "e")
+    _seg(p, 10.0, 100)
+    append_event(p, "violation", ts=11.0, invariant="NoTwoLeaders")
+    s = _summary(p, now=12.0)
+    assert s["status"] == "live (VIOLATION NoTwoLeaders)"
+
+
+def test_monitor_attribution_timestampless_is_unjudged(tmp_path):
+    p = str(tmp_path / "e")
+    with open(p, "w") as fh:        # legacy .stats line: no ts anywhere
+        fh.write(json.dumps({"n_states": 100, "wall_s": 1.0,
+                             "level": 1}) + "\n")
+    s = _summary(p, now=9999.0)
+    assert s["stale"] is None
+    assert s["status"] == "live?"   # no timestamps: no crash verdict

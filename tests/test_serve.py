@@ -348,3 +348,27 @@ def test_load_jobs_queue_dir_and_errors(tmp_path):
     empty.mkdir()
     with pytest.raises(ValueError, match="no \\*.json jobs"):
         load_jobs(str(empty))
+
+
+def test_load_jobs_queue_skips_partial_writes(tmp_path):
+    """Queue-dir intake races a producer mid-write: the torn file is
+    retried once, then skipped with attribution — never poisons the
+    scan (campaign supervision satellite)."""
+    write_cfg(tmp_path / "toy.cfg")
+    qdir = tmp_path / "queue"
+    qdir.mkdir()
+    (qdir / "001-good.json").write_text(json.dumps(
+        {"cfg": str(tmp_path / "toy.cfg"), "spec": "election"}))
+    (qdir / "002-torn.json").write_text('{"cfg": "toy.cfg", "spe')
+    skipped = []
+    jobs = load_jobs(str(qdir), skipped=skipped)
+    assert [j.job_id for j in jobs] == ["001-good"]
+    assert [name for name, _ in skipped] == ["002-torn.json"]
+    assert skipped[0][1]                 # the parse error is attributed
+
+    # every job file unreadable: that is not a race, it is a dead queue
+    bad = tmp_path / "dead-queue"
+    bad.mkdir()
+    (bad / "x.json").write_text("{")
+    with pytest.raises(ValueError, match="unreadable"):
+        load_jobs(str(bad))

@@ -84,3 +84,13 @@ python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
     | tee "$SERVE_TMP/megakernel.out" | tail -2
 grep -q "^3014 distinct states found" "$SERVE_TMP/megakernel.out" \
     || { echo "megakernel smoke FAILED: expected 3014 states"; exit 1; }
+
+echo "== chaos smoke (campaign SIGKILL + reshard 1->2->1, CPU) =="
+# The campaign supervisor's acceptance loop in miniature: reference run,
+# then SIGKILL after the 2nd checkpoint, auto-reshard across a 1->2->1
+# virtual-mesh plan, unattended resume — finals must be identical.
+python -m raft_tla_tpu.campaign.chaos "$SERVE_TMP/toy.cfg" \
+    --workdir "$SERVE_TMP/campaign" --spec election \
+    --max-term 2 --max-log 0 --max-msgs 2 \
+    --window 128 --chunk 32 --kill-after 2 --mesh-plan 1,2,1 --cpu \
+    | tail -3
