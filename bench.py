@@ -255,6 +255,35 @@ def run_megakernel_probe() -> None:
     print(json.dumps(out))
 
 
+def run_walker_probe() -> None:
+    """Child process: pinned walker-fleet throughput at the fiducial
+    bounds.
+
+    One solo ``Simulator`` (fused single-fetch path), compile carried by
+    a warm-up run, then a measured run — ``walker_states_per_sec`` is
+    the sustained sampled-state rate the simulation engines deliver on
+    this chip today.  Same role as the megakernel column: a drift
+    tracker next to the exhaustive fiducials, never the verdict (the
+    deciding sharded-vs-solo comparison is runs/fleet_ab.py).
+    """
+    from raft_tla_tpu.config import Bounds, CheckConfig
+    from raft_tla_tpu.simulate import Simulator
+
+    cfg = CheckConfig(
+        bounds=Bounds(n_servers=3, n_values=2, max_term=2, max_log=1,
+                      max_msgs=2, max_dup=1),
+        spec="full", invariants=("NoTwoLeaders", "LogMatching"))
+    sim = Simulator(cfg, walkers=1024, depth=100, steps_per_dispatch=64,
+                    seed=0)
+    sim.run(1024)                                     # compile + warm
+    r = sim.run(4096)
+    print(json.dumps({
+        "walker_states_per_sec": round(r.states_per_sec, 1),
+        "walker_probe_states": r.n_states,
+        "walker_probe_wall_s": round(r.wall_s, 3),
+    }))
+
+
 def run_northstar() -> None:
     """Child process: the time-boxed symmetric full-``Next`` 3s/2v probe.
 
@@ -392,6 +421,27 @@ def main() -> None:
         mk = {"megakernel_probe_error": "unparseable"}
     fid.update(mk)
     _partial.update(mk)
+    # -- part 0.7: walker-throughput probe column ---------------------------
+    # pinned simulation-mode rate (RESULTS.md "Fleet scaling A/B") — same
+    # error-tolerant merge as the megakernel column: a probe failure is a
+    # recorded column, never the round's verdict.
+    try:
+        proc = subprocess.run([sys.executable, __file__, "--walkers"],
+                              capture_output=True, text=True, timeout=600)
+        if proc.returncode == 0:
+            wp = json.loads(proc.stdout.strip().splitlines()[-1])
+            print(f"walker probe: {wp['walker_states_per_sec']:,.0f} "
+                  "sampled states/s (1024 walkers, depth 100)",
+                  file=sys.stderr)
+        else:
+            sys.stderr.write(proc.stderr[-2000:])
+            wp = {"walker_probe_error": f"rc={proc.returncode}"}
+    except subprocess.TimeoutExpired:
+        wp = {"walker_probe_error": "timeout"}
+    except (ValueError, IndexError, KeyError):
+        wp = {"walker_probe_error": "unparseable"}
+    fid.update(wp)
+    _partial.update(wp)
 
     events_path = os.environ.get("RAFT_TLA_EVENTS")
     if events_path:
@@ -471,5 +521,7 @@ if __name__ == "__main__":
         run_fiducial()
     elif len(sys.argv) == 2 and sys.argv[1] == "--megakernel":
         run_megakernel_probe()
+    elif len(sys.argv) == 2 and sys.argv[1] == "--walkers":
+        run_walker_probe()
     else:
         main()

@@ -49,6 +49,8 @@ DEFAULT_TARGETS = (
     "raft_tla_tpu/serve",
     "raft_tla_tpu/campaign",
     "raft_tla_tpu/frontend",
+    "raft_tla_tpu/fleet",
+    "raft_tla_tpu/simulate.py",
 )
 
 _NARROW_DTYPES = {"int8", "int16", "uint8", "uint16", "bfloat16", "float16",

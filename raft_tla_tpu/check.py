@@ -263,9 +263,27 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="--simulate: maximum behavior length (TLC's "
                         "-depth; default 100)")
     p.add_argument("--walkers", type=int, default=1024,
-                   help="--simulate: parallel walkers per device step")
+                   help="--simulate: parallel walkers per device step "
+                        "(with --fleet: the GLOBAL fleet size, split "
+                        "evenly over the mesh)")
     p.add_argument("--seed", type=int, default=0,
-                   help="--simulate: PRNG seed (same seed = same walks)")
+                   help="--simulate: PRNG seed (same seed = same walks; "
+                        "with --fleet, the same walks at any device "
+                        "count)")
+    p.add_argument("--fleet", action="store_true",
+                   help="--simulate: shard the walker fleet over the "
+                        "device mesh (--devices; statistical checking "
+                        "at serving scale, bit-reproducible across "
+                        "mesh shapes)")
+    p.add_argument("--steer", type=float, default=0.0, metavar="TAU",
+                   help="--fleet: coverage-steering temperature — bias "
+                        "lane sampling against over-visited actions by "
+                        "TAU * log1p(visits/mean) (default 0 = off; "
+                        "exact replay preserved)")
+    p.add_argument("--fault-weights", default=None, metavar="F=W,...",
+                   help="--fleet: per-action-family sampling weights, "
+                        "e.g. 'Restart=2,DropMessage=0.5' (sampling "
+                        "policy only; enabledness untouched)")
     return p
 
 
@@ -294,30 +312,67 @@ def _stats_cb(args):
     return cb
 
 
+def _parse_fault_weights(text):
+    """``Fam=W,Fam=W`` -> dict; raises ValueError on malformed cells
+    (family-name validity is checked by the fleet engine, which knows
+    the spec's action table)."""
+    if not text:
+        return None
+    out = {}
+    for cell in text.split(","):
+        fam, eq, w = cell.partition("=")
+        if not eq or not fam.strip():
+            raise ValueError(f"bad --fault-weights cell {cell!r} "
+                             "(want Family=Weight,...)")
+        out[fam.strip()] = float(w)
+    return out
+
+
 def _simulate(args, config):
     """TLC -simulate analog; returns a TLC-compatible exit code."""
     from raft_tla_tpu.engine import DEADLOCK
-    from raft_tla_tpu.simulate import Simulator
-    sim = Simulator(config, walkers=args.walkers, depth=args.depth,
-                    seed=args.seed)
+    if args.fleet:
+        from raft_tla_tpu.fleet import FleetSimulator
+        from raft_tla_tpu.parallel.shard_engine import make_mesh
+        sim = FleetSimulator(config, mesh=make_mesh(args.devices),
+                             walkers=args.walkers, depth=args.depth,
+                             seed=args.seed, steer_tau=args.steer,
+                             fault_weights=_parse_fault_weights(
+                                 args.fault_weights))
+    else:
+        from raft_tla_tpu.simulate import Simulator
+        sim = Simulator(config, walkers=args.walkers, depth=args.depth,
+                        seed=args.seed)
     # --stats/--events flow through the same RunTelemetry facade as the
     # exhaustive engines (the events path rides the env set in main()).
     res = sim.run(args.simulate, on_progress=_stats_cb(args))
     print(f"{res.n_behaviors} behaviors generated ({res.n_states} states, "
           f"deepest {res.max_depth_seen}), {res.wall_s:.2f}s "
           f"({res.states_per_sec:,.0f} states/s).")
+    if args.fleet:
+        print(f"Fleet: {res.n_devices} devices x "
+              f"{res.walkers // res.n_devices} walkers"
+              + (f", steer tau={res.steer_tau:g}" if res.steer_tau
+                 else "")
+              + f"; action-coverage entropy {res.coverage_entropy:.3f}")
     if res.violation is None:
         print("Model checking completed. No error has been found.")
         print(f"  (simulation: {args.simulate} behaviors of depth "
               f"<= {args.depth}; not exhaustive)")
+        if args.fleet:
+            conf = res.confidence(config.invariants)
+            per = conf["per_invariant"]
+            for nm in config.invariants:
+                print(f"  {nm}: held on {per[nm]:,} sampled states")
         return EXIT_OK
     is_deadlock = res.violation.invariant == DEADLOCK
     if args.no_trace:
         print("Error: Deadlock reached." if is_deadlock else
               f"Error: Invariant {res.violation.invariant} is violated.")
     else:
-        from raft_tla_tpu.utils.render import render_trace
-        print(render_trace(res.violation, config.bounds))
+        from raft_tla_tpu.frontend import resolve_model
+        model = resolve_model(config.spec)
+        print(model.render_trace(res.violation, config.bounds))
     return EXIT_DEADLOCK if is_deadlock else EXIT_VIOLATION
 
 
@@ -602,8 +657,17 @@ def main(argv=None) -> int:
     if not model.is_raft and args.engine not in model.engines:
         p.error(f"--engine {args.engine} does not support spec "
                 f"{args.spec!r} (supported: {', '.join(model.engines)})")
-    if not model.is_raft and args.simulate is not None:
-        p.error(f"--simulate is Raft-only (got --spec {args.spec})")
+    if args.simulate is not None and "simulate" not in model.engines:
+        p.error(f"--simulate is not supported by spec {args.spec!r} "
+                f"(supported engines: {', '.join(model.engines)})")
+    if args.fleet and args.simulate is None:
+        p.error("--fleet requires --simulate N (fleets are a "
+                "simulation-mode engine)")
+    if args.steer and not args.fleet:
+        p.error("--steer requires --fleet (coverage steering lives in "
+                "the sharded fleet engine)")
+    if args.fault_weights and not args.fleet:
+        p.error("--fault-weights requires --fleet")
 
     if not args.no_lint:
         # Width-safety (analysis Pass 1) before any step build: for these
@@ -640,6 +704,18 @@ def main(argv=None) -> int:
                 print(f"Error: {e}", file=sys.stderr)
                 return EXIT_ERROR
             print(f"TLC parity artifacts: {tla}, {cfgp}")
+        if args.simulate is not None:
+            if props:
+                print(f"Error: PROPERTY {list(props)} cannot be checked "
+                      "in --simulate mode (liveness needs exhaustive "
+                      "search)", file=sys.stderr)
+                return EXIT_ERROR
+            _force_cpu(args)
+            try:
+                return _simulate(args, config)
+            except Exception as e:
+                print(f"Error: {e}", file=sys.stderr)
+                return EXIT_ERROR
         return _finish_run(args, p, config, props, model, b)
     print(f"raft_tla_tpu {__import__('raft_tla_tpu').__version__} — "
           f"exhaustive check of Spec (raft.tla:469), subset: {args.spec}")

@@ -1,0 +1,20 @@
+"""Mesh-sharded walker fleets — statistical checking at serving scale.
+
+Promotes simulation mode (``raft_tla_tpu/simulate``) from a
+single-device afterthought to a first-class sharded workload:
+``FleetSimulator`` shard_maps the jitted walk segment over a 1-D device
+mesh (the ``parallel/`` virtual-mesh infrastructure), with per-walker
+PRNG streams folded from one root seed so a fixed (seed, walkers,
+depth) reproduces the same walks bit for bit at ANY device count, and
+one fused device->host fetch per segment.
+
+``scenario`` adds the coverage/steering layer: weighted fault-action
+sampling (Restart/Duplicate/Drop intensity sweeps) and the
+scenario-matrix runner.
+"""
+
+from raft_tla_tpu.fleet.engine import FleetResult, FleetSimulator
+from raft_tla_tpu.fleet.scenario import Scenario, fault_matrix, run_matrix
+
+__all__ = ["FleetResult", "FleetSimulator", "Scenario", "fault_matrix",
+           "run_matrix"]

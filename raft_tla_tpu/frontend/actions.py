@@ -146,6 +146,27 @@ def compile_kernels(defs):
             for adef in defs}
 
 
+def build_schema_expand(schema, defs, table, bounds):
+    """The expand half of :func:`build_schema_step` on its own:
+    ``expand(struct) -> (succs[A, ...], valid[A], ovf[A])`` in
+    action_table order — the same contract as ``kernels.build_expand``,
+    which is what the simulation engines vmap per walker (they sample
+    one lane per step instead of fingerprinting the whole fan-out)."""
+    fam_kernels = compile_kernels(defs)
+    groups = K.group_instances(table)
+
+    def expand(s):
+        succs, valids, ovfs = K.grouped_dispatch(
+            bounds, s, groups, family_kernels=fam_kernels)
+        all_succs = jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *succs)
+        return (all_succs,
+                jnp.concatenate(valids, axis=0),
+                jnp.concatenate(ovfs, axis=0))
+
+    return expand
+
+
 def build_schema_step(schema, defs, table, bounds, predicates=()):
     """Generic fused step for a schema-declared spec.
 
@@ -161,17 +182,7 @@ def build_schema_step(schema, defs, table, bounds, predicates=()):
     """
     lay = schema.layout(bounds)
     consts = jnp.asarray(fpr.lane_constants(lay.width))
-    fam_kernels = compile_kernels(defs)
-    groups = K.group_instances(table)
-
-    def expand(s):
-        succs, valids, ovfs = K.grouped_dispatch(
-            bounds, s, groups, family_kernels=fam_kernels)
-        all_succs = jax.tree.map(
-            lambda *xs: jnp.concatenate(xs, axis=0), *succs)
-        return (all_succs,
-                jnp.concatenate(valids, axis=0),
-                jnp.concatenate(ovfs, axis=0))
+    expand = build_schema_expand(schema, defs, table, bounds)
 
     def step(vecs):
         structs = jax.vmap(lambda v: lay.unpack(v, jnp))(vecs)

@@ -8,6 +8,12 @@ the registry for a *model adapter* and go through its uniform surface:
 - ``init_py`` / ``to_vec`` / ``from_vec`` / ``init_fingerprint`` /
   ``constraint_ok`` / ``py_invariant`` — the host-side half of the BFS
   (roots, trace decoding, frontier invariant probes);
+- ``build_sim_expand`` / ``sim_codec`` / ``jnp_invariants`` /
+  ``jnp_constraint`` / ``host_apply`` — the simulation surface (present
+  when ``"simulate" in engines``): the per-state action fan-out the
+  walker engines sample from, the struct<->vec codec, traced invariant /
+  constraint probes, and the host interpreter one lane at a time for
+  exact violation replay;
 - ``render_state`` / ``render_trace`` — violation reporting;
 - ``check_widths(bounds)`` — the admission-time width/validity gate;
 - ``resolve_check_config(cfg, opts, path)`` — cfg-file -> CheckConfig
@@ -102,6 +108,39 @@ class RaftModel:
         from raft_tla_tpu.analysis import widthcheck
         return widthcheck.check_widths(bounds, self.sub)
 
+    # -- simulation surface (walker engines) --------------------------------
+
+    def build_sim_expand(self, config: CheckConfig):
+        from raft_tla_tpu.ops import kernels
+        fk = None
+        if self.use_ir:
+            from raft_tla_tpu.frontend import raft_ir
+            fk = raft_ir.family_kernels(config.bounds)
+        return kernels.build_expand(config.bounds, self.sub,
+                                    family_kernels=fk)
+
+    def sim_codec(self, bounds):
+        import jax.numpy as jnp
+        from raft_tla_tpu.ops import state as st
+        lay = st.Layout.of(bounds)
+        return (lay.width,
+                lambda t: st.pack(t, jnp),
+                lambda v: st.unpack(v, lay, jnp))
+
+    def jnp_invariants(self, config: CheckConfig):
+        from raft_tla_tpu.models import invariants as inv_mod
+        return tuple(inv_mod.jnp_invariant(nm, config.bounds)
+                     for nm in config.invariants)
+
+    def jnp_constraint(self, bounds):
+        import jax.numpy as jnp
+        from raft_tla_tpu.ops import state as st
+        return lambda t: st.constraint_ok(t, bounds, jnp)
+
+    def host_apply(self, py, inst, bounds):
+        from raft_tla_tpu.models import interp
+        return interp.apply_action(py, inst, bounds)
+
 
 class TwoPhaseModel:
     """Bounded two-phase commit, compiled from frontend declarations
@@ -113,7 +152,7 @@ class TwoPhaseModel:
     sub = "twophase"
     is_raft = False
     use_ir = True
-    engines = ("host",)
+    engines = ("host", "simulate")
 
     def _mod(self):
         from raft_tla_tpu.frontend import twophase
@@ -186,6 +225,34 @@ class TwoPhaseModel:
     def check_widths(self, bounds):
         from raft_tla_tpu.frontend.schema import check_schema
         return check_schema(self._mod().SCHEMA, bounds)
+
+    # -- simulation surface (walker engines) --------------------------------
+
+    def build_sim_expand(self, config: CheckConfig):
+        from raft_tla_tpu.frontend import actions
+        tp = self._mod()
+        return actions.build_schema_expand(
+            tp.SCHEMA, tp.ACTIONS, tp.action_table(config.bounds),
+            config.bounds)
+
+    def sim_codec(self, bounds):
+        import jax.numpy as jnp
+        lay = self._mod().SCHEMA.layout(bounds)
+        return (lay.width,
+                lambda t: lay.pack(t, jnp),
+                lambda v: lay.unpack(v, jnp))
+
+    def jnp_invariants(self, config: CheckConfig):
+        import jax.numpy as jnp
+        preds = tuple(self._predicate(nm) for nm in config.invariants)
+        return tuple((lambda t, p=p: p.ev(t, jnp)) for p in preds)
+
+    def jnp_constraint(self, bounds):
+        import jax.numpy as jnp
+        return lambda t: jnp.bool_(True)   # finite space, no constraint
+
+    def host_apply(self, py, inst, bounds):
+        return self._mod().apply_instance(py, inst, bounds)
 
     def emit_tla(self, out_dir, bounds, invariants=()):
         return self._mod().emit_tla(out_dir, bounds, invariants)

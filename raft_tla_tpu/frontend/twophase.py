@@ -267,6 +267,54 @@ def _py_successors(s: TPState, n: int):
     return out
 
 
+def apply_instance(s: TPState, inst: TPInstance,
+                   bounds: Bounds) -> TPState | None:
+    """Host interpreter for one action lane (simulation replay): the
+    successor for ``inst`` if its guard holds, else None — the same
+    hand-transcribed guards as :func:`_py_successors`, addressed by
+    lane instead of enumerated, so a recorded walk replays exactly."""
+    rm, fam = inst.i, inst.family
+    if fam == TM_RCV_PREPARED:
+        if s.tmState == TM_INIT and s.msgPrepared[rm]:
+            tp = list(s.tmPrepared)
+            tp[rm] = 1
+            return s._replace(tmPrepared=tuple(tp))
+        return None
+    if fam == TM_COMMIT:
+        if s.tmState == TM_INIT and all(s.tmPrepared):
+            return s._replace(tmState=TM_COMMITTED, msgCommit=1)
+        return None
+    if fam == TM_ABORT:
+        if s.tmState == TM_INIT:
+            return s._replace(tmState=TM_ABORTED, msgAbort=1)
+        return None
+    if fam == RM_PREPARE:
+        if s.rmState[rm] == WORKING:
+            rs, mp = list(s.rmState), list(s.msgPrepared)
+            rs[rm], mp[rm] = PREPARED, 1
+            return s._replace(rmState=tuple(rs), msgPrepared=tuple(mp))
+        return None
+    if fam == RM_CHOOSE_ABORT:
+        if s.rmState[rm] == WORKING:
+            rs = list(s.rmState)
+            rs[rm] = ABORTED
+            return s._replace(rmState=tuple(rs))
+        return None
+    if fam == RM_RCV_COMMIT:
+        if s.msgCommit:
+            rs = list(s.rmState)
+            rs[rm] = COMMITTED
+            return s._replace(rmState=tuple(rs))
+        return None
+    if fam == RM_RCV_ABORT:
+        if s.msgAbort:
+            rs = list(s.rmState)
+            rs[rm] = ABORTED
+            return s._replace(rmState=tuple(rs))
+        return None
+    raise ValueError(f"unknown twophase action family {fam!r}")
+
+
 def py_tc_consistent(s: TPState) -> bool:
     """TCConsistent, hand-written (the oracle face of the predicate)."""
     return not (any(r == ABORTED for r in s.rmState)

@@ -38,6 +38,10 @@ class RefResult:
     violation: Optional[Violation]
     levels: list           # new-state count per level (levels[0] = 1 = Init)
     wall_s: float
+    # The oracle never stops early (no checkpoint/deadline machinery), so
+    # a returned result is always a complete exploration — the CLI's
+    # lossless-stop gate reads this like every other engine's result.
+    complete: bool = True
 
 
 def check(config: CheckConfig, max_states: int | None = None,

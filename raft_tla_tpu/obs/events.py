@@ -25,7 +25,7 @@ wall).  This module replaces all of that with:
   ``level_end`` derived automatically from level transitions and
   ``violation`` derived from the final :class:`~raft_tla_tpu.engine.EngineResult`.
 
-Event grammar (``SCHEMA_VERSION`` = 2; version-1 lines remain valid) —
+Event grammar (``SCHEMA_VERSION`` = 3; version-1/2 lines remain valid) —
 every line is one JSON object with base fields ``v`` (schema version),
 ``event`` (type) and ``ts`` (unix epoch seconds):
 
@@ -49,10 +49,23 @@ Version 2 adds the campaign-supervisor lifecycle (emitted by
 ``reshard``        ndev_src, ndev_dst [+ n_states, path, block]
 ``resume_attempt`` attempt [+ path, ndev, backoff_s, quarantined]
 
+Version 3 adds the statistical-checking (walker fleet) fields — both
+optional, both invalid on a ``"v" < 3`` line:
+
+``segment.device_rates``   per-device walker states/s for the segment
+                           (fleet runs; list of numbers, mesh order)
+``run_end.sim``            confidence summary for simulation runs:
+                           behaviors / sampled_transitions / max_depth /
+                           walkers / n_devices / coverage_entropy /
+                           steer_tau / per_invariant states-checked —
+                           what a statistical run actually established,
+                           next to the exhaustive engines' proofs
+
 A run log with no ``run_end`` means the process died — crash attribution
 for free.  The schema is strict: unknown fields fail validation and the
-v2-only event types are invalid on a ``"v": 1`` line, so any addition
-requires a version bump (versioning policy in README.md).
+v2-only event types (resp. v3-only fields) are invalid on a ``"v": 1``
+(resp. ``"v" < 3``) line, so any addition requires a version bump
+(versioning policy in README.md).
 """
 
 from __future__ import annotations
@@ -65,8 +78,8 @@ import subprocess
 import threading
 import time
 
-SCHEMA_VERSION = 2
-_VERSIONS = (1, 2)           # versions validate_event accepts
+SCHEMA_VERSION = 3
+_VERSIONS = (1, 2, 3)        # versions validate_event accepts
 
 # Environment knobs (set by check.py --events/--phase-timers; inherited by
 # liveness re-runs and bench children the same way RAFT_TLA_SIGPRUNE is).
@@ -126,18 +139,24 @@ _REQUIRED = {
 # supervisor lifecycle) — invalid on a "v": 1 line.
 _V2_EVENTS = frozenset({"preempt", "reshard", "resume_attempt"})
 
+# Fields that only exist from schema version 3 on (walker-fleet
+# statistical checking) — invalid on a "v" < 3 line.
+_V3_FIELDS = {"segment": frozenset({"device_rates"}),
+              "run_end": frozenset({"sim"})}
+
 _OPTIONAL = {
     "run_start": {"bounds": dict, "symmetry": list, "view": str,
                   "chunk": int, "caps": str, "n_states": int,
                   "n_devices": int, "git_sha": str, "fiducials": dict,
                   "pid": int},
     "segment": {"coverage": dict, "route_peak": int, "n_devices": int,
-                "inv_evals": dict, "phase_s": dict},
+                "inv_evals": dict, "phase_s": dict, "device_rates": list},
     "level_end": {},
     "checkpoint": {"n_states": int},
     "violation": {"kind": str},
     "stop_requested": {"source": str, "pid": int},
-    "run_end": {"diameter": int, "levels": list, "wall_s": _NUM},
+    "run_end": {"diameter": int, "levels": list, "wall_s": _NUM,
+                "sim": dict},
     "preempt": {"detail": str, "pid": int, "stale_s": _NUM,
                 "drift": dict},
     "reshard": {"n_states": int, "path": str, "block": int},
@@ -176,6 +195,7 @@ def validate_event(d: dict) -> list:
             errs.append(f"{ev}: missing required field {k!r}")
         elif not _is(d[k], spec):
             errs.append(f"{ev}: field {k!r} has wrong type")
+    v3_only = _V3_FIELDS.get(ev, frozenset())
     for k, val in d.items():
         if k in _BASE or k in req:
             continue
@@ -184,6 +204,8 @@ def validate_event(d: dict) -> list:
                         "additions need a version bump)")
         elif not _is(val, opt[k]):
             errs.append(f"{ev}: field {k!r} has wrong type")
+        elif k in v3_only and d["v"] in _VERSIONS and d["v"] < 3:
+            errs.append(f"{ev}: field {k!r} requires schema version >= 3")
     return errs
 
 
@@ -219,6 +241,7 @@ class ProgressRecord:
     n_devices: int | None = None      # shard engines: mesh size
     inv_evals: dict | None = None     # per-invariant evaluation counts
     phase_s: dict | None = None       # per-phase wall since last record
+    device_rates: list | None = None  # fleet: per-device walker states/s
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -260,7 +283,8 @@ class ProgressTracker:
     def record(self, n_states: int, level: int, n_transitions: int,
                coverage: dict | None = None, route_peak: int | None = None,
                n_incl: int | None = None,
-               phase_s: dict | None = None) -> ProgressRecord:
+               phase_s: dict | None = None,
+               device_rates: list | None = None) -> ProgressRecord:
         wall = time.monotonic() - self.t0
         reported = n_states if n_incl is None else max(n_states, n_incl)
         if self._prev_n is None:  # unknown baseline: anchor, rate 0
@@ -289,6 +313,7 @@ class ProgressTracker:
             n_devices=self.n_devices,
             inv_evals=inv_evals,
             phase_s=phase_s or None,
+            device_rates=device_rates,
         )
 
 
@@ -477,11 +502,13 @@ class RunTelemetry:
 
     def segment(self, n_states: int, level: int, n_transitions: int,
                 coverage: dict | None = None, route_peak: int | None = None,
-                n_incl: int | None = None) -> ProgressRecord:
+                n_incl: int | None = None,
+                device_rates: list | None = None) -> ProgressRecord:
         rec = self.tracker.record(
             n_states, level, n_transitions, coverage=coverage,
             route_peak=route_peak, n_incl=n_incl,
-            phase_s=self.phases.snapshot())
+            phase_s=self.phases.snapshot(),
+            device_rates=device_rates)
         if self.log is not None:
             if self._last_level is not None and level > self._last_level:
                 # The boundary count is the count as observed at the first
@@ -528,6 +555,35 @@ class RunTelemetry:
             complete=bool(result.complete), outcome=outcome,
             diameter=int(result.diameter), levels=list(result.levels),
             wall_s=round(float(result.wall_s), 3))
+
+    def run_end_sim(self, *, n_states: int, n_behaviors: int,
+                    max_depth: int, wall_s: float, complete: bool,
+                    violation=None, sim: dict | None = None) -> None:
+        """``run_end`` for statistical (simulation) runs: honest per-field
+        semantics instead of shoehorning walker counters into the
+        exhaustive-result shape.  ``n_transitions`` is the sampled
+        transition count (== states generated along walks), ``diameter``
+        the deepest walk observed, and the v3 ``sim`` dict carries the
+        confidence summary (behaviors, per-invariant states-checked,
+        coverage entropy, fleet geometry).
+        """
+        if self.log is None or self._ended:
+            return
+        self._ended = True
+        outcome = "ok" if complete else "stopped"
+        if violation is not None:
+            inv = violation.invariant
+            kind = "deadlock" if inv == _DEADLOCK_NAME else "invariant"
+            self.violation(inv, kind=kind)
+            outcome = "violation"
+        fields = dict(
+            n_states=int(n_states), n_transitions=int(n_states),
+            complete=bool(complete), outcome=outcome,
+            diameter=int(max_depth), levels=[],
+            wall_s=round(float(wall_s), 3))
+        if sim is not None:
+            fields["sim"] = dict(sim, behaviors=int(n_behaviors))
+        self.log.emit("run_end", **fields)
 
     def close(self) -> None:
         if self.log is not None:

@@ -21,11 +21,22 @@ Behavior-end rules (TLC semantics):
   invariant-checked (CONSTRAINT gates exploration, not generation), then
   the behavior ends and the walker resets;
 - **invariant violation** — the run stops; the trace is reconstructed by
-  replaying the recorded lane history through the reference interpreter
-  (models/interp.py), so the reported behavior is exact, not approximate.
+  replaying the recorded lane history through the model's host
+  interpreter, so the reported behavior is exact, not approximate.
 
 Determinism: one ``jax.random`` key drives everything; the same seed,
 batch size and depth reproduce the same walks bit for bit.
+
+The simulator is model-generic: it drives the registry adapter's
+simulation surface (``build_sim_expand`` / ``sim_codec`` /
+``jnp_invariants`` / ``jnp_constraint`` / ``host_apply``), so any spec
+whose adapter advertises ``"simulate" in engines`` — Raft or a
+schema-declared spec like twophase — random-walks through the same
+engine.  The host side fetches the carry **once per dispatch** (a single
+fused device_get instead of a per-field sync storm) and donates the
+walker/history buffers back to the next dispatch; the sharded fleet
+engine (``raft_tla_tpu/fleet``) scales the same segment across a device
+mesh.
 """
 
 from __future__ import annotations
@@ -40,9 +51,6 @@ import numpy as np
 
 from raft_tla_tpu.config import CheckConfig
 from raft_tla_tpu.engine import DEADLOCK, Violation
-from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
-from raft_tla_tpu.ops import kernels
-from raft_tla_tpu.ops import state as st
 
 I32 = jnp.int32
 
@@ -61,19 +69,20 @@ class SimResult:
 
 
 def _build_sim_segment(config: CheckConfig, walkers: int, depth: int,
-                       steps: int, W: int, A: int):
+                       steps: int, W: int, A: int, model):
     """One jitted dispatch: advance every walker by up to ``steps`` steps."""
     bounds = config.bounds
     n_inv = len(config.invariants)
-    expand = kernels.build_expand(bounds, config.spec)
-    inv_fns = [inv_mod.jnp_invariant(nm, bounds) for nm in config.invariants]
-    lay = st.Layout.of(bounds)
+    expand = model.build_sim_expand(config)
+    inv_fns = list(model.jnp_invariants(config))
+    con_fn = model.jnp_constraint(bounds)
+    _w, pack, unpack = model.sim_codec(bounds)
     BIG = jnp.int32(np.iinfo(np.int32).max)
 
     def one_step(carry, key, init_vec):
         (vecs, hist, hlen, n_beh, n_st, maxd, viol_w, viol_i, dead_w,
          fail) = carry
-        structs = jax.vmap(lambda v: st.unpack(v, lay, jnp))(vecs)
+        structs = jax.vmap(unpack)(vecs)
         succs, valid, ovf = jax.vmap(expand)(structs)       # [B, A, ...]
 
         # sample one enabled lane per walker (uniform over enabled), then
@@ -85,8 +94,8 @@ def _build_sim_segment(config: CheckConfig, walkers: int, depth: int,
         lane = jnp.where(enabled, lane, 0)
         rows = jnp.arange(walkers)
         pick_s = jax.tree.map(lambda x: x[rows, lane], succs)
-        pick = jax.vmap(lambda t: st.pack(t, jnp))(pick_s)  # [B, W]
-        con_ok = jax.vmap(lambda t: st.constraint_ok(t, bounds, jnp))(pick_s)
+        pick = jax.vmap(pack)(pick_s)                       # [B, W]
+        con_ok = jax.vmap(con_fn)(pick_s)
         # capacity overflow on a taken lane is a soundness bug — loud, never
         # clamped (SURVEY §4.5), like every engine.
         fail = fail | jnp.any(enabled & ovf[rows, lane])
@@ -167,28 +176,57 @@ def _build_sim_segment(config: CheckConfig, walkers: int, depth: int,
     return segment
 
 
+def resolve_sim_model(config: CheckConfig):
+    """The model adapter for a simulation run, or a loud error when the
+    spec's adapter has no simulation surface."""
+    from raft_tla_tpu.frontend.registry import resolve_model
+    model = resolve_model(config.spec)
+    if "simulate" not in getattr(model, "engines", ()):
+        raise ValueError(
+            f"spec {config.spec!r} does not support simulation "
+            f"(engines: {', '.join(model.engines)})")
+    return model
+
+
 class Simulator:
-    """Batched random-behavior generator for one :class:`CheckConfig`."""
+    """Batched random-behavior generator for one :class:`CheckConfig`.
+
+    ``fetch`` selects the host-side carry readback: ``"fused"`` (default)
+    pulls the whole segment result in one device_get; ``"legacy"`` keeps
+    the historical per-field ``bool()``/``int()`` sync storm, retained
+    only so ``runs/fleet_ab.py`` can measure the delta honestly.
+    """
 
     def __init__(self, config: CheckConfig, walkers: int = 1024,
                  depth: int = 100, steps_per_dispatch: int = 64,
-                 seed: int = 0):
+                 seed: int = 0, fetch: str = "fused"):
         if config.symmetry:
             raise ValueError("simulation mode ignores SYMMETRY; run without")
+        if fetch not in ("fused", "legacy"):
+            raise ValueError(f"fetch must be 'fused' or 'legacy': {fetch!r}")
         self.config = config
         self.bounds = config.bounds
-        self.lay = st.Layout.of(self.bounds)
-        self.table = S.action_table(self.bounds, config.spec)
+        self.model = resolve_sim_model(config)
+        self.width, _pack, _unpack = self.model.sim_codec(self.bounds)
+        self.table = self.model.action_table(self.bounds)
         self.A = len(self.table)
         self.walkers = walkers
         self.depth = depth
         self.steps = steps_per_dispatch
         self.seed = seed
-        self._segment = jax.jit(_build_sim_segment(
-            config, walkers, depth, self.steps, self.lay.width, self.A))
+        self.fetch = fetch
+        # Donate the walker/history buffers: shapes match the outputs
+        # exactly, so off-CPU the dispatch updates them in place instead
+        # of holding both generations live.  (CPU has no donation; gate
+        # it off there to keep runs warning-free.)
+        donate = () if jax.default_backend() == "cpu" else (2, 3, 4)
+        self._segment = jax.jit(
+            _build_sim_segment(config, walkers, depth, self.steps,
+                               self.width, self.A, self.model),
+            donate_argnums=donate)
 
     def run(self, n_behaviors: int,
-            init_override: interp.PyState | None = None,
+            init_override=None,
             max_wall_s: float | None = None,
             on_progress=None, events: str | None = None) -> SimResult:
         t0 = time.monotonic()
@@ -202,11 +240,11 @@ class Simulator:
                            on_progress=on_progress, events=events, t0=t0)
         bounds = self.bounds
         init_py = init_override if init_override is not None \
-            else interp.init_state(bounds)
-        init_vec = interp.to_vec(init_py, bounds)
+            else self.model.init_py(bounds)
+        init_vec = self.model.to_vec(init_py, bounds)
         tel.run_start()
         for nm in self.config.invariants:
-            if not inv_mod.py_invariant(nm)(init_py, bounds):
+            if not self.model.py_invariant(nm)(init_py, bounds):
                 res = SimResult(0, 1, 0,
                                 Violation(nm, init_py, [(None, init_py)]),
                                 time.monotonic() - t0)
@@ -216,7 +254,7 @@ class Simulator:
 
         key = jax.random.PRNGKey(self.seed)
         vecs = jnp.broadcast_to(jnp.asarray(init_vec, I32),
-                                (self.walkers, self.lay.width))
+                                (self.walkers, self.width))
         hist = jnp.zeros((self.walkers, self.depth), I32)
         hlen = jnp.zeros((self.walkers,), I32)
         n_beh = jnp.int32(0)
@@ -227,7 +265,18 @@ class Simulator:
             (vecs, hist, hlen, n_beh, n_st, maxd, viol_w, viol_i,
              dead_w, fail) = self._segment(sub, iv, vecs, hist, hlen,
                                            n_beh, n_st, maxd)
-            if bool(fail):
+            if self.fetch == "legacy":
+                # the historical per-field sync storm (A/B reference arm)
+                failh, nb, nst = bool(fail), int(n_beh), int(n_st)
+                mx, vw, vi, dw = (int(maxd), int(viol_w), int(viol_i),
+                                  int(dead_w))
+            else:
+                # one fused device->host fetch per dispatch: every carry
+                # scalar materializes in a single blocking transfer.
+                failh, nb, nst, mx, vw, vi, dw = (
+                    x.item() for x in jax.device_get(
+                        (fail, n_beh, n_st, maxd, viol_w, viol_i, dead_w)))
+            if failh:
                 tel.stop_requested("tensor-encoding overflow",
                                    source="simulate")
                 tel.close()
@@ -236,59 +285,58 @@ class Simulator:
                     "the tensor encoding — bounds reasoning violated "
                     "(config.py capacity scheme)")
             if tel.active:
-                tel.segment(int(n_st), int(maxd), int(n_st))
-            vw, dw = int(viol_w), int(dead_w)
+                tel.segment(nst, mx, nst)
             if vw >= 0 or dw >= 0:
                 # If both landed in the same dispatch (different walkers),
                 # report the invariant violation — its walker's history is
                 # the one we replay, so label and trace must agree.
                 w = vw if vw >= 0 else dw
-                name = self.config.invariants[int(viol_i)] if vw >= 0 \
-                    else DEADLOCK
+                name = self.config.invariants[vi] if vw >= 0 else DEADLOCK
                 trace = self._replay(init_py, np.asarray(hist[w]),
                                      int(hlen[w]))
                 res = SimResult(
-                    n_behaviors=int(n_beh), n_states=int(n_st),
-                    max_depth_seen=int(maxd),
+                    n_behaviors=nb, n_states=nst, max_depth_seen=mx,
                     violation=Violation(name, trace[-1][1], trace),
                     wall_s=time.monotonic() - t0)
                 self._end_telemetry(tel, res, complete=True)
                 return res
-            if int(n_beh) >= n_behaviors:
+            if nb >= n_behaviors:
                 complete = True
                 break
             if max_wall_s is not None and \
                     time.monotonic() - t0 > max_wall_s:
                 complete = False    # wall-bounded partial run
                 break
-        res = SimResult(n_behaviors=int(n_beh), n_states=int(n_st),
-                        max_depth_seen=int(maxd), violation=None,
+        res = SimResult(n_behaviors=nb, n_states=nst,
+                        max_depth_seen=mx, violation=None,
                         wall_s=time.monotonic() - t0)
         self._end_telemetry(tel, res, complete=complete)
         return res
 
-    @staticmethod
-    def _end_telemetry(tel, res: SimResult, complete: bool) -> None:
-        """Adapt a :class:`SimResult` to the run_end contract (the facade
-        reads EngineResult field names; simulation has no BFS levels)."""
-        class _End:
-            n_states = res.n_states
-            n_transitions = res.n_states    # one transition per sampled state
-            violation = res.violation
-            diameter = res.max_depth_seen
-            levels: list = []
-            wall_s = res.wall_s
-        _End.complete = complete
-        tel.run_end(_End)
+    def _end_telemetry(self, tel, res: SimResult, complete: bool) -> None:
+        """Honest per-field run_end for a statistical run: behaviors,
+        sampled transitions and max depth each land in their own field
+        (obs schema v3 ``sim`` dict) instead of being aliased through the
+        exhaustive-result shape."""
+        tel.run_end_sim(
+            n_states=res.n_states, n_behaviors=res.n_behaviors,
+            max_depth=res.max_depth_seen, wall_s=res.wall_s,
+            complete=complete, violation=res.violation,
+            sim={"sampled_transitions": res.n_states,
+                 "max_depth": res.max_depth_seen,
+                 "walkers": self.walkers,
+                 "per_invariant": {nm: res.n_states
+                                   for nm in self.config.invariants}})
         tel.close()
 
     def _replay(self, init_py, lanes: np.ndarray, hlen: int) -> list:
-        """Rebuild the violating walk exactly through the interpreter."""
+        """Rebuild the violating walk exactly through the model's host
+        interpreter."""
         chain = [(None, init_py)]
         cur = init_py
         for k in range(hlen):
             a = self.table[int(lanes[k])]
-            nxt = interp.apply_action(cur, a, self.bounds)
+            nxt = self.model.host_apply(cur, a, self.bounds)
             assert nxt is not None, "recorded lane must be enabled on replay"
             chain.append((a.label(), nxt))
             cur = nxt

@@ -196,7 +196,7 @@ def test_service_end_to_end_twophase(tmp_path):
 
 def test_model_engine_gate():
     model = resolve_model("twophase")
-    assert model.engines == ("host",)
+    assert model.engines == ("host", "simulate")
     assert not model.is_raft
 
 

@@ -294,7 +294,7 @@ def test_check_cli_runs_lint_by_default(monkeypatch, capsys):
         check_mod, "_run",
         lambda args, config: types.SimpleNamespace(
             n_states=1, diameter=0, n_transitions=0, coverage={},
-            violation=None))
+            violation=None, complete=True))
     assert check_mod.main([FLAGSHIP]) == check_mod.EXIT_OK    # warn-only
     assert "width-overflow" in capsys.readouterr().err
     assert check_mod.main([FLAGSHIP, "--lint", "strict"]) == \

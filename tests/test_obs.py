@@ -139,7 +139,8 @@ def test_validate_rejects_unknowns_and_type_drift():
     assert validate_event({**ok, "level": "3"})             # type drift
     assert validate_event({**ok, "level": True})            # bool is not int
     assert validate_event({**ok, "v": 2}) == []             # v2 superset
-    assert validate_event({**ok, "v": 3})                   # future version
+    assert validate_event({**ok, "v": 3}) == []             # v3 superset
+    assert validate_event({**ok, "v": 4})                   # future version
     assert validate_event({"v": 1, "event": "level_end", "ts": 0.0,
                            "level": 3})                     # missing field
 

@@ -142,6 +142,56 @@ def test_simulation_emits_event_log(tmp_path):
     assert events[-1]["outcome"] == "ok" and events[-1]["complete"]
 
 
+def test_fused_fetch_matches_legacy():
+    """The single fused device_get per dispatch (the sync-storm fix)
+    changes transfer count only — never results."""
+    mk = lambda fetch: Simulator(CV, walkers=64, depth=16,   # noqa: E731
+                                 steps_per_dispatch=8, seed=7,
+                                 fetch=fetch)
+    rf = mk("fused").run(2000, init_override=seeded_start())
+    rl = mk("legacy").run(2000, init_override=seeded_start())
+    assert rf.violation is not None
+    assert rf.violation.trace == rl.violation.trace
+    assert (rf.n_behaviors, rf.n_states, rf.max_depth_seen) == \
+        (rl.n_behaviors, rl.n_states, rl.max_depth_seen)
+
+
+def test_simulate_rejects_unknown_fetch():
+    with pytest.raises(ValueError, match="fetch"):
+        Simulator(CV, fetch="eager")
+
+
+def test_twophase_simulation():
+    """--simulate is spec-generic now: the twophase model drives the
+    same walker engine through its sim surface (satellite of ISSUE 11),
+    and violating walks replay through its host interpreter."""
+    cc = CheckConfig(bounds=Bounds(n_servers=2, n_values=1),
+                     spec="twophase", invariants=("TCConsistent",))
+    r = Simulator(cc, walkers=64, depth=20, steps_per_dispatch=10,
+                  seed=3).run(200)
+    assert r.violation is None and r.n_behaviors >= 200
+
+    bad = CheckConfig(bounds=Bounds(n_servers=2, n_values=1),
+                      spec="twophase", invariants=("~(msgCommit = 1)",))
+    rv = Simulator(bad, walkers=64, depth=20, steps_per_dispatch=10,
+                   seed=3).run(200)
+    assert rv.violation is not None
+    assert rv.violation.trace[-1][1] == rv.violation.state
+
+
+def test_cli_twophase_simulate(tmp_path):
+    from test_cli import run_cli
+    from raft_tla_tpu import check as cli
+    cfg = tmp_path / "2pc.cfg"
+    cfg.write_text("SPECIFICATION Spec\nCONSTANT RM = {r1, r2}\n"
+                   "INVARIANT TCConsistent\n")
+    code, out = run_cli(str(cfg), "--engine", "host", "--spec",
+                        "twophase", "--simulate", "100", "--depth", "20",
+                        "--walkers", "32", "--seed", "3")
+    assert code == cli.EXIT_OK
+    assert "behaviors generated" in out and "not exhaustive" in out
+
+
 def test_cli_simulate_rejects_properties(tmp_path):
     from test_cli import run_cli, write_cfg
     from raft_tla_tpu import check as cli

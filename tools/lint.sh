@@ -94,3 +94,17 @@ python -m raft_tla_tpu.campaign.chaos "$SERVE_TMP/toy.cfg" \
     --max-term 2 --max-log 0 --max-msgs 2 \
     --window 128 --chunk 32 --kill-after 2 --mesh-plan 1,2,1 --cpu \
     | tail -3
+
+echo "== fleet smoke (sharded walker fleet, 2 virtual devices, CPU) =="
+# Deterministic seed: the same cfg at the same seed must report the same
+# behavior/state counts every run, on any mesh (the fleet's
+# device-count-invariance contract in one grep).
+python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
+    --engine ref --spec election --max-term 2 --max-log 0 --max-msgs 2 \
+    --simulate 200 --depth 20 --walkers 64 --seed 5 \
+    --fleet --devices 2 --cpu \
+    | tee "$SERVE_TMP/fleet.out" | tail -4
+grep -q "^Fleet: 2 devices x 32 walkers" "$SERVE_TMP/fleet.out" \
+    || { echo "fleet smoke FAILED: no fleet summary"; exit 1; }
+grep -q "behaviors generated" "$SERVE_TMP/fleet.out" \
+    || { echo "fleet smoke FAILED: no behaviors line"; exit 1; }
