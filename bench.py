@@ -117,6 +117,12 @@ def run_fiducial() -> None:
     os.environ["RAFT_TLA_PRESCAN"] = "off"
     os.environ["RAFT_TLA_SIGPRUNE"] = "off"
     os.environ["RAFT_TLA_MEGAKERNEL"] = "off"
+    # the compile_wall_ms probe must measure a REAL XLA build: a warm
+    # persistent compilation cache (serve/sched.enable_compile_cache,
+    # RAFT_TLA_COMPILE_CACHE) would turn it into a disk-read fiducial.
+    # Must be pinned before jax imports in this child.
+    os.environ["JAX_ENABLE_COMPILATION_CACHE"] = "false"
+    os.environ.pop("RAFT_TLA_COMPILE_CACHE", None)
 
     import jax
     import jax.numpy as jnp
@@ -160,7 +166,9 @@ def run_fiducial() -> None:
     step = jax.jit(kernels.build_step(bounds, spec,
                                       ("NoTwoLeaders", "LogMatching"),
                                       ("Server",)))
+    t_c = time.monotonic()
     jax.block_until_ready(step(vecs))                    # compile
+    compile_ms = (time.monotonic() - t_c) * 1e3
     step_ms = _median_ms(lambda: step(vecs))
 
     # -- measured elementwise ceiling --------------------------------------
@@ -186,6 +194,7 @@ def run_fiducial() -> None:
 
     print(json.dumps({
         "copy_512mb_ms": round(copy_ms, 2),
+        "compile_wall_ms": round(compile_ms, 1),
         "synthetic_step_ms": round(step_ms, 2),
         "words_per_sec": round(words_per_sec, 1),
         "pct_vpu_peak": round(100.0 * words_per_sec / peak_words_per_sec,
@@ -395,6 +404,7 @@ def main() -> None:
     fid = _child(["--fiducial"], timeout=300, what="fiducial")
     _partial.update(fid)
     print(f"fiducial: 512MB copy {fid['copy_512mb_ms']:.1f} ms, "
+          f"step compile {fid.get('compile_wall_ms', 0.0):,.0f} ms, "
           f"synthetic step {fid['synthetic_step_ms']:.1f} ms, "
           f"{fid['words_per_sec']:,.0f} orbit-words/s "
           f"({fid['pct_vpu_peak']:.1f}% of measured VPU ceiling)",

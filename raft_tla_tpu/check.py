@@ -246,6 +246,12 @@ def build_argparser() -> argparse.ArgumentParser:
                         "tail it live with raft-tla-monitor. Sets "
                         "RAFT_TLA_EVENTS process-wide so liveness "
                         "re-runs inherit the same log")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent JAX compilation-cache directory "
+                        "(also via RAFT_TLA_COMPILE_CACHE): repeated "
+                        "runs of the same bounds skip XLA compilation "
+                        "entirely — the serve daemon's warm-start knob, "
+                        "useful for single checks too")
     p.add_argument("--phase-timers", action="store_true",
                    help="attribute wall time to search phases (upload/"
                         "expand/export/dedup/snapshot) in each segment "
@@ -600,6 +606,8 @@ def main(argv=None) -> int:
         # time (ops/kernels._megakernel_enabled) by every engine family.
         import os
         os.environ["RAFT_TLA_MEGAKERNEL"] = args.megakernel
+    from raft_tla_tpu.serve.sched import enable_compile_cache
+    enable_compile_cache(args.compile_cache)
     _DEVICE_ENGINES = ("device", "paged", "streamed", "ddd", "shard",
                        "pagedshard", "ddd-shard")
     if args.view and args.simulate:

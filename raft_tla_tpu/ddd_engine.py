@@ -91,6 +91,39 @@ U32 = jnp.uint32
 _IDX_CEIL = 1 << 62
 
 
+def install_sigint_boundary_stop(eng, stack, boundary="segment") -> None:
+    """The runs/campaign_stop.sh contract, shared by the DDD engine
+    family: the FIRST SIGINT sets ``eng._sigint``, a flag the engine's
+    harvest loop reads next to the deadline check, so the engine stops
+    at the next *boundary* (segment for ddd, window for ddd-shard) —
+    pending candidates flushed, a snapshot saved when a --checkpoint
+    path is configured, and a normal ``complete=False`` EngineResult
+    returned (the campaign wrapper then prints its endpoint JSON).
+    A SECOND SIGINT restores the previous handler and aborts raw
+    (KeyboardInterrupt), for when the graceful path is itself wedged
+    behind a dead dispatch.  signal.signal is main-thread-only; off the
+    main thread the flag stays False and Ctrl-C keeps its raw meaning.
+    The previous handler is restored via ``stack`` on every exit."""
+    import signal
+    import sys
+    import threading
+    eng._sigint = False
+    if threading.current_thread() is not threading.main_thread():
+        return
+    prev = signal.getsignal(signal.SIGINT)
+
+    def handler(_signum, _frame):
+        if eng._sigint:
+            signal.signal(signal.SIGINT, prev)
+            raise KeyboardInterrupt
+        eng._sigint = True
+        print(f"SIGINT: stopping at the next {boundary} boundary "
+              "(SIGINT again aborts raw)", file=sys.stderr, flush=True)
+
+    signal.signal(signal.SIGINT, handler)
+    stack.callback(signal.signal, signal.SIGINT, prev)
+
+
 @dataclasses.dataclass(frozen=True)
 class DDDCapacities:
     """Static shapes.  ``block``: frontier upload granularity; ``table``:
@@ -993,36 +1026,7 @@ class DDDEngine:
                 stack, events)
 
     def _install_sigint(self, stack) -> None:
-        """The runs/campaign_stop.sh contract: the FIRST SIGINT sets a
-        flag the harvest loop reads next to the deadline check, so the
-        engine stops at the next segment boundary — pending candidates
-        flushed, a snapshot saved when a --checkpoint path is
-        configured, and a normal ``complete=False`` EngineResult
-        returned (the campaign wrapper then prints its endpoint JSON).
-        A SECOND SIGINT restores the previous handler and aborts raw
-        (KeyboardInterrupt), for when the graceful path is itself
-        wedged behind a dead dispatch.  signal.signal is main-thread-
-        only; off the main thread the flag stays False and Ctrl-C keeps
-        its raw meaning."""
-        import signal
-        import sys
-        import threading
-        self._sigint = False
-        if threading.current_thread() is not threading.main_thread():
-            return
-        prev = signal.getsignal(signal.SIGINT)
-
-        def handler(_signum, _frame):
-            if self._sigint:
-                signal.signal(signal.SIGINT, prev)
-                raise KeyboardInterrupt
-            self._sigint = True
-            print("SIGINT: stopping at the next segment boundary "
-                  "(SIGINT again aborts raw)", file=sys.stderr,
-                  flush=True)
-
-        signal.signal(signal.SIGINT, handler)
-        stack.callback(signal.signal, signal.SIGINT, prev)
+        install_sigint_boundary_stop(self, stack, boundary="segment")
 
     def _check_impl(self, init_override, on_progress, checkpoint,
                     checkpoint_every_s, resume, deadline_s,

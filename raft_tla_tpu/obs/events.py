@@ -61,11 +61,21 @@ optional, both invalid on a ``"v" < 3`` line:
                            what a statistical run actually established,
                            next to the exhaustive engines' proofs
 
+Version 4 adds the serve-scheduler attribution fields — both optional,
+both invalid on a ``"v" < 4`` line:
+
+``segment.bin``            the step-signature bin tag of a serve lane's
+                           dispatch stream, so the monitor can attribute
+                           device time per compiled bin
+``segment.inflight``       async-scheduler dispatches in flight when the
+                           segment boundary was observed (0 = the lane
+                           ran synchronously)
+
 A run log with no ``run_end`` means the process died — crash attribution
 for free.  The schema is strict: unknown fields fail validation and the
-v2-only event types (resp. v3-only fields) are invalid on a ``"v": 1``
-(resp. ``"v" < 3``) line, so any addition requires a version bump
-(versioning policy in README.md).
+v2-only event types (resp. v3/v4-only fields) are invalid on a ``"v": 1``
+(resp. ``"v" < 3`` / ``"v" < 4``) line, so any addition requires a
+version bump (versioning policy in README.md).
 """
 
 from __future__ import annotations
@@ -78,8 +88,8 @@ import subprocess
 import threading
 import time
 
-SCHEMA_VERSION = 3
-_VERSIONS = (1, 2, 3)        # versions validate_event accepts
+SCHEMA_VERSION = 4
+_VERSIONS = (1, 2, 3, 4)     # versions validate_event accepts
 
 # Environment knobs (set by check.py --events/--phase-timers; inherited by
 # liveness re-runs and bench children the same way RAFT_TLA_SIGPRUNE is).
@@ -144,13 +154,18 @@ _V2_EVENTS = frozenset({"preempt", "reshard", "resume_attempt"})
 _V3_FIELDS = {"segment": frozenset({"device_rates"}),
               "run_end": frozenset({"sim"})}
 
+# Fields that only exist from schema version 4 on (serve async-scheduler
+# per-bin attribution) — invalid on a "v" < 4 line.
+_V4_FIELDS = {"segment": frozenset({"bin", "inflight"})}
+
 _OPTIONAL = {
     "run_start": {"bounds": dict, "symmetry": list, "view": str,
                   "chunk": int, "caps": str, "n_states": int,
                   "n_devices": int, "git_sha": str, "fiducials": dict,
                   "pid": int},
     "segment": {"coverage": dict, "route_peak": int, "n_devices": int,
-                "inv_evals": dict, "phase_s": dict, "device_rates": list},
+                "inv_evals": dict, "phase_s": dict, "device_rates": list,
+                "bin": str, "inflight": int},
     "level_end": {},
     "checkpoint": {"n_states": int},
     "violation": {"kind": str},
@@ -196,6 +211,7 @@ def validate_event(d: dict) -> list:
         elif not _is(d[k], spec):
             errs.append(f"{ev}: field {k!r} has wrong type")
     v3_only = _V3_FIELDS.get(ev, frozenset())
+    v4_only = _V4_FIELDS.get(ev, frozenset())
     for k, val in d.items():
         if k in _BASE or k in req:
             continue
@@ -206,6 +222,8 @@ def validate_event(d: dict) -> list:
             errs.append(f"{ev}: field {k!r} has wrong type")
         elif k in v3_only and d["v"] in _VERSIONS and d["v"] < 3:
             errs.append(f"{ev}: field {k!r} requires schema version >= 3")
+        elif k in v4_only and d["v"] in _VERSIONS and d["v"] < 4:
+            errs.append(f"{ev}: field {k!r} requires schema version >= 4")
     return errs
 
 
@@ -242,6 +260,8 @@ class ProgressRecord:
     inv_evals: dict | None = None     # per-invariant evaluation counts
     phase_s: dict | None = None       # per-phase wall since last record
     device_rates: list | None = None  # fleet: per-device walker states/s
+    bin: str | None = None            # serve: step-signature bin tag
+    inflight: int | None = None       # serve: dispatches in flight
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -284,7 +304,9 @@ class ProgressTracker:
                coverage: dict | None = None, route_peak: int | None = None,
                n_incl: int | None = None,
                phase_s: dict | None = None,
-               device_rates: list | None = None) -> ProgressRecord:
+               device_rates: list | None = None,
+               bin: str | None = None,
+               inflight: int | None = None) -> ProgressRecord:
         wall = time.monotonic() - self.t0
         reported = n_states if n_incl is None else max(n_states, n_incl)
         if self._prev_n is None:  # unknown baseline: anchor, rate 0
@@ -314,6 +336,8 @@ class ProgressTracker:
             inv_evals=inv_evals,
             phase_s=phase_s or None,
             device_rates=device_rates,
+            bin=bin,
+            inflight=inflight,
         )
 
 
@@ -503,12 +527,15 @@ class RunTelemetry:
     def segment(self, n_states: int, level: int, n_transitions: int,
                 coverage: dict | None = None, route_peak: int | None = None,
                 n_incl: int | None = None,
-                device_rates: list | None = None) -> ProgressRecord:
+                device_rates: list | None = None,
+                bin: str | None = None,
+                inflight: int | None = None) -> ProgressRecord:
         rec = self.tracker.record(
             n_states, level, n_transitions, coverage=coverage,
             route_peak=route_peak, n_incl=n_incl,
             phase_s=self.phases.snapshot(),
-            device_rates=device_rates)
+            device_rates=device_rates,
+            bin=bin, inflight=inflight)
         if self.log is not None:
             if self._last_level is not None and level > self._last_level:
                 # The boundary count is the count as observed at the first

@@ -215,6 +215,8 @@ def summarize(stream: dict, window_s: float = 600.0,
         "inc_states_per_sec": _trailing_rate(segments, window_s),
         "since_resume": cur.get("since_resume"),
         "route_peak": cur.get("route_peak"),
+        "bin": cur.get("bin"),
+        "inflight": cur.get("inflight"),
         "level_sizes": _level_sizes(events, segments),
         "target": target,
         "legacy": stream["legacy"],
@@ -303,6 +305,13 @@ def heartbeat(summary: dict | None) -> str:
             parts.append(f"{short} drift {summary['fiducial_drift'][key]:.2f}x")
     if summary.get("route_peak") is not None:
         parts.append(f"route_peak {summary['route_peak']}")
+    if summary.get("bin") is not None:
+        # serve lanes: which compiled step signature this tenant rode, and
+        # how deep the async scheduler's dispatch pipeline ran
+        tag = f"bin {summary['bin']}"
+        if summary.get("inflight") is not None:
+            tag += f" (inflight {summary['inflight']})"
+        parts.append(tag)
     if summary.get("last_event_age_s") is not None:
         parts.append(f"last ev {summary['last_event_age_s']:.0f}s ago")
     parts.append(summary["status"])

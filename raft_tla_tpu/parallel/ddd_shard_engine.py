@@ -632,7 +632,9 @@ class DDDShardEngine:
               resume: str | None = None,
               events: str | None = None) -> EngineResult:
         import contextlib
+        from raft_tla_tpu.ddd_engine import install_sigint_boundary_stop
         with contextlib.ExitStack() as stack:
+            install_sigint_boundary_stop(self, stack, boundary="window")
             return self._check_impl(init_override, on_progress,
                                     checkpoint, checkpoint_every_s,
                                     resume, stack, events)
@@ -746,6 +748,7 @@ class DDDShardEngine:
         fail = 0
         viol = None        # (kind, inv_idx, key_or_gid) once detected
         stopped = False
+        complete = True    # False on a graceful SIGINT window-boundary stop
         pacer = pacing.SegmentPacer(self.seg_chunks, self.SEG_MIN,
                                     self.SEG_MAX, self.SEG_TARGET_S,
                                     self.SEG_CLAMP_S)
@@ -910,6 +913,27 @@ class DDDShardEngine:
                                              (hi0, lo0))
                     tel.checkpoint(checkpoint, n_states)
                     last_ckpt = time.monotonic()
+                if getattr(self, "_sigint", False):
+                    # Graceful-stop contract (install_sigint_boundary_
+                    # stop): stop at the WINDOW boundary, the only point
+                    # where the canonical shard-major stream order is
+                    # whole — pend/staging just drained, blocks_done just
+                    # advanced, every counter (incl. n_trans: all of this
+                    # window's segments are harvested) names exactly the
+                    # completed-window prefix.  A mid-window drain would
+                    # emit a partial window in shard-major order and
+                    # diverge from the uninterrupted stream.
+                    complete = False
+                    stopped = True
+                    tel.stop_requested("sigint")
+                    if checkpoint:
+                        with tel.phases.phase("snapshot"):
+                            self.save_checkpoint(
+                                checkpoint, host, constore, keystore,
+                                n_states, n_trans, cov, level_ends,
+                                blocks_done, (hi0, lo0))
+                        tel.checkpoint(checkpoint, n_states)
+                    break
             if stopped:
                 break
             blocks_done = 0
@@ -1009,7 +1033,7 @@ class DDDShardEngine:
             n_states=n_states, diameter=len(levels_arr) - 1,
             n_transitions=n_trans, coverage=coverage,
             violation=violation, levels=levels_arr,
-            wall_s=time.monotonic() - t0)
+            wall_s=time.monotonic() - t0, complete=complete)
         tel.run_end(result)
         return result
 

@@ -33,8 +33,6 @@ import time
 from collections import Counter
 from typing import Optional
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from raft_tla_tpu.config import CheckConfig
@@ -42,6 +40,7 @@ from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation, _VecStore
 from raft_tla_tpu.obs import RunTelemetry
 from raft_tla_tpu.ops import fingerprint as fpr
 from raft_tla_tpu.ops import kernels
+from raft_tla_tpu.serve.sched import DispatchScheduler
 
 
 def bin_key(config: CheckConfig) -> tuple:
@@ -127,6 +126,13 @@ class _Lane:
         self.frontier = [0] if self.violation is None and \
             model.constraint_ok(init_py, bounds) else []
         self.cursor = 0
+        # Slices taken by a dispatch but not yet harvested.  The cursor
+        # advances at take() time (so a speculative same-level dispatch
+        # can claim the NEXT rows before the previous harvest lands), so
+        # "cursor at end of frontier" alone no longer means the level is
+        # done — promotion must also wait for the in-flight count to
+        # drain back to zero.
+        self.inflight_slices = 0
         if self.violation is not None or not self.frontier:
             self._finish()
 
@@ -214,20 +220,25 @@ class _Lane:
             self.violation = self._make_violation(
                 DEADLOCK, gidx[dead_limit // self.A])
 
-    def advance(self, max_states: int | None) -> None:
+    def advance(self, max_states: int | None,
+                inflight: int | None = None) -> None:
         """Post-slice lane control: violation stop, level promotion,
-        completion — with a per-lane segment event at each boundary."""
+        completion — with a per-lane segment event at each boundary.
+        ``inflight`` is the scheduler's dispatch-pipeline depth at the
+        boundary (schema-v4 attribution, with the lane's bin tag)."""
         if self.violation is not None:
             self._finish()
             return
-        if self.cursor < len(self.frontier):
+        if self.cursor < len(self.frontier) or self.inflight_slices > 0:
             return                      # level still in flight
         if self.new_this_level:
             self.levels.append(self.new_this_level)
         if self.tel is not None:
             self.tel.segment(len(self.store), len(self.levels) - 1,
                              self.n_transitions,
-                             coverage=dict(self.coverage))
+                             coverage=dict(self.coverage),
+                             bin=getattr(self, "bin_tag", None),
+                             inflight=inflight)
         if max_states is not None and len(self.store) > max_states:
             raise _LaneFailure(f"state count exceeded {max_states}")
         self.frontier = self.next_frontier
@@ -282,26 +293,26 @@ class _Lane:
 
 
 class _Bin:
-    """One step signature: a compiled fused step + the lanes sharing it."""
+    """One step signature: a fused step + the lanes sharing it.  The
+    step is *built* here (host-side closure, cheap) but *compiled* by
+    the scheduler — AOT on a background thread when async compiles are
+    on, lazily at first dispatch otherwise — so a new signature never
+    stalls bins that are already serving."""
 
-    def __init__(self, key: tuple, config: CheckConfig):
+    def __init__(self, key: tuple, config: CheckConfig, tag: str = "bin"):
         from raft_tla_tpu.frontend import resolve_model
         self.key = key
+        self.tag = tag                  # stable per-run label (obs v4)
         self.bounds = config.bounds
         self.model = resolve_model(config.spec)
         self.lay = self.model.layout(config.bounds)
         self.table = self.model.action_table(config.bounds)
         self.A = len(self.table)
-        self.step = jax.jit(self.model.build_step(config))
+        self.step_fn = self.model.build_step(config)
         self.lanes: list[_Lane] = []
-        self.rr = 0                     # round-robin fill offset
 
     def live_lanes(self) -> list:
         return [ln for ln in self.lanes if ln.active]
-
-
-def _next_pow2(x: int) -> int:
-    return 1 << max(0, (x - 1)).bit_length()
 
 
 class BatchExecutor:
@@ -311,11 +322,26 @@ class BatchExecutor:
     ``[B, W]`` step); ``max_states`` is a per-lane cap mirroring
     ``engine.Engine.check(max_states=)``.  ``run`` returns
     ``{job_id: LaneOutcome}`` — one terminal record per job, always.
+
+    Every dispatch is routed through :class:`~raft_tla_tpu.serve.sched.
+    DispatchScheduler`: ``depth`` fused dispatches ride the device at
+    once (issue bin B's step while bin A's harvest runs on the host) and
+    new-bin compiles run on a background thread.  ``depth=1`` with
+    ``compile_async=False`` is the synchronous PR 6 baseline (the A/B
+    sequential arm).  ``stop`` is an optional zero-arg callable polled at
+    dispatch boundaries: when truthy, in-flight work is harvested and
+    every still-active lane is stopped with drain attribution — the
+    daemon's lossless-SIGINT contract.
     """
 
-    def __init__(self, chunk: int = 1024, max_states: int | None = None):
+    def __init__(self, chunk: int = 1024, max_states: int | None = None,
+                 depth: int = 2, compile_async: bool = True, stop=None):
         self.chunk = chunk
         self.max_states = max_states
+        self.depth = depth
+        self.compile_async = compile_async
+        self.stop = stop
+        self.last_stats: dict | None = None   # scheduler stats of last run
 
     def run(self, jobs, telemetry: dict | None = None,
             init_overrides: dict | None = None) -> dict:
@@ -326,7 +352,6 @@ class BatchExecutor:
         engines' ``init_override`` hook (parity tests seed from it)."""
         telemetry = telemetry or {}
         init_overrides = init_overrides or {}
-        B = self.chunk
         bins: dict[tuple, _Bin] = {}
         outcomes: dict[str, LaneOutcome] = {}
         lanes: list[_Lane] = []
@@ -337,107 +362,35 @@ class BatchExecutor:
             key = bin_key(config)
             bn = bins.get(key)
             if bn is None:
-                bn = bins[key] = _Bin(key, config)
+                bn = bins[key] = _Bin(key, config, tag=f"bin{len(bins)}")
             lane = _Lane(job_id, config, bn.table, bn.lay,
                          tel=telemetry.get(job_id),
                          init_override=init_overrides.get(job_id),
                          model=bn.model)
+            lane.bin_tag = bn.tag
             bn.lanes.append(lane)
             lanes.append(lane)
             if not lane.active:         # init-state verdict, no dispatch
                 outcomes[job_id] = lane.outcome
 
+        sched = DispatchScheduler(
+            chunk=self.chunk, max_states=self.max_states,
+            depth=self.depth, compile_async=self.compile_async,
+            stop=self.stop)
         try:
-            while True:
-                progressed = False
-                for bn in bins.values():
-                    if self._dispatch(bn, B, outcomes):
-                        progressed = True
-                if not progressed:
-                    break
+            self.last_stats = sched.run(bins, outcomes)
+            # The scheduler returns with live lanes only when stopped
+            # (daemon drain) or when a bin's step never became runnable:
+            # both get an attributed terminal record, never silence.
+            stopped = bool(self.stop and self.stop())
+            for lane in lanes:
+                if lane.active:
+                    lane.fail("stop requested (drain)" if stopped
+                              else "scheduler quiescent with live lanes "
+                                   "(step unrunnable)")
+                    outcomes[lane.job_id] = lane.outcome
         finally:
             for lane in lanes:
                 if lane.tel is not None:
                     lane.tel.close()
         return {ln.job_id: outcomes[ln.job_id] for ln in lanes}
-
-    # -- internals ------------------------------------------------------------
-
-    def _dispatch(self, bn: _Bin, B: int, outcomes: dict) -> bool:
-        """Pack one chunk from the bin's live frontiers, run the fused
-        step once, demux per lane.  Returns False when the bin is idle."""
-        live = bn.live_lanes()
-        if not live:
-            return False
-        # Rotate the fill order so no lane monopolizes the chunk when the
-        # bin is oversubscribed; slots freed by finished lanes go to the
-        # survivors automatically (the backfill IS this fill loop).
-        order = live[bn.rr % len(live):] + live[:bn.rr % len(live)]
-        bn.rr += 1
-        slices = []                     # (lane, r0, nb, gidx)
-        parts = []
-        pos = 0
-        for lane in order:
-            if pos == B:
-                break
-            take = min(B - pos, lane.pending_rows())
-            if take <= 0:
-                continue
-            gidx, vecs = lane.take(take)
-            slices.append((lane, pos, take, gidx))
-            parts.append(vecs)
-            pos += take
-        if not slices:
-            return False
-        W = bn.lay.width
-        vecs = np.concatenate(parts, axis=0)
-        if pos < B:                     # pad to the static chunk shape
-            vecs = np.concatenate(
-                [vecs, np.broadcast_to(vecs[0], (B - pos, W))], axis=0)
-        out = bn.step(jnp.asarray(vecs))
-
-        valid = np.asarray(out["valid"])
-        ovf = np.asarray(out["overflow"])
-        keys = fpr.to_u64(np.asarray(out["fp_hi"]),
-                          np.asarray(out["fp_lo"]))
-        inv_ok = np.asarray(out["inv_ok"])
-        con_ok = np.asarray(out["con_ok"])
-
-        # Phase 1 per lane slice; collect the chunk-global flat indices
-        # of every accepted new state for one shared device gather.
-        sel_flat: list[int] = []
-        committing = []
-        for lane, r0, nb, gidx in slices:
-            sl = slice(r0, r0 + nb)
-            try:
-                new_flat = lane.scan_slice(valid[sl], ovf[sl], keys[sl],
-                                           inv_ok[sl], con_ok[sl], gidx)
-            except _LaneFailure as e:
-                lane.fail(str(e))
-                outcomes[lane.job_id] = lane.outcome
-                continue
-            committing.append((lane, len(new_flat)))
-            sel_flat.extend(r0 * bn.A + fi for fi in new_flat)
-
-        # One gather for the whole dispatch (padded to a pow2 bucket so
-        # the eager gather compiles O(log) distinct shapes), then split
-        # back per lane in chunk order.
-        n_new = len(sel_flat)
-        if n_new:
-            cap = _next_pow2(n_new)
-            sel = np.asarray(sel_flat + [0] * (cap - n_new), dtype=np.int64)
-            rows_all = np.asarray(
-                out["svecs"].reshape(B * bn.A, W)[jnp.asarray(sel)])[:n_new]
-        else:
-            rows_all = np.empty((0, W), dtype=np.int32)
-        off = 0
-        for lane, n_lane in committing:
-            lane.commit_slice(rows_all[off:off + n_lane])
-            off += n_lane
-            try:
-                lane.advance(self.max_states)
-            except _LaneFailure as e:
-                lane.fail(str(e))
-            if not lane.active:
-                outcomes[lane.job_id] = lane.outcome
-        return True

@@ -38,10 +38,13 @@ import time
 _JOB_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 
 
-def load_jobs(source: str, skipped: list | None = None) -> list:
+def load_jobs(source: str, skipped: list | None = None,
+              only: list | None = None) -> list:
     """Read :class:`CheckJob` entries from a JSONL manifest file or a
     queue directory of ``*.json`` job files (sorted name order — the
-    queue convention: producers write ``NNN-name.json``).
+    queue convention: producers write ``NNN-name.json``).  ``only``
+    restricts a queue-dir scan to the named files (the daemon's
+    incremental intake; an empty restricted scan is then not an error).
 
     Queue-dir intake is race-tolerant: a producer writing a job file the
     moment the service scans the directory must not poison the whole
@@ -61,7 +64,11 @@ def load_jobs(source: str, skipped: list | None = None) -> list:
     entries: list[tuple[str | None, dict]] = []
     if os.path.isdir(source):
         names = sorted(n for n in os.listdir(source) if n.endswith(".json"))
-        if not names:
+        if only is not None:
+            names = [n for n in names if n in set(only)]
+            if not names:
+                return []
+        elif not names:
             raise ValueError(f"queue directory {source!r} has no *.json jobs")
         for n in names:
             path = os.path.join(source, n)
@@ -139,11 +146,19 @@ def _reject_events(path: str, job, reason: str) -> None:
 
 
 def run_service(jobs, out_dir: str, chunk: int = 1024,
-                max_states: int | None = None, quiet: bool = False) -> list:
+                max_states: int | None = None, quiet: bool = False,
+                depth: int = 2, compile_async: bool = True,
+                stop=None) -> list:
     """Admit + execute + record: returns the results.jsonl records.
 
     Split from the CLI so tests (and later fronts — a socket server, an
     elastic-fleet supervisor) drive the same path with in-memory jobs.
+    ``depth``/``compile_async`` configure the async dispatch scheduler
+    (serve/sched.py; depth 1 + sync compile = the PR 6 synchronous
+    executor); ``stop`` is a zero-arg callable the executor polls at
+    dispatch boundaries — the daemon's SIGINT drain hook: when it turns
+    truthy, in-flight dispatches are harvested and every unfinished lane
+    gets an attributed "stop requested (drain)" record.
     """
     from raft_tla_tpu.obs import RunTelemetry
     from raft_tla_tpu.serve.batch import BatchExecutor
@@ -203,8 +218,11 @@ def run_service(jobs, out_dir: str, chunk: int = 1024,
     outcomes = {}
     if admitted:
         say(f"serving {len(admitted)} admitted job(s) "
-            f"({len(jobs) - len(admitted)} rejected) — chunk {chunk}")
-        ex = BatchExecutor(chunk=chunk, max_states=max_states)
+            f"({len(jobs) - len(admitted)} rejected) — chunk {chunk}, "
+            f"pipeline depth {depth}")
+        ex = BatchExecutor(chunk=chunk, max_states=max_states,
+                           depth=depth, compile_async=compile_async,
+                           stop=stop)
         outcomes = ex.run([(job.job_id, adm.config)
                            for job, adm, rec in admitted],
                           telemetry=telemetry)
@@ -238,6 +256,144 @@ def run_service(jobs, out_dir: str, chunk: int = 1024,
     return records
 
 
+def _append_records(out_dir: str, records: list) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "results.jsonl"), "a",
+              encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def run_daemon(source: str, out_dir: str, chunk: int = 1024,
+               max_states: int | None = None, quiet: bool = False,
+               depth: int = 2, poll_s: float = 2.0,
+               max_idle_polls: int | None = None) -> int:
+    """The long-running front: ``raft-tla-serve QUEUE_DIR --watch``.
+
+    Continuous intake atop the one-pass queue-dir code path: every poll
+    picks up job files not yet processed and runs them as one executor
+    batch (so cross-bin interleaving spans the whole arrival burst).
+    Each job file is parsed in isolation — a malformed file is retried
+    across a few polls (a producer may be mid-write) and then recorded
+    as a rejected result instead of poisoning the loop; a job id already
+    served this daemon's lifetime is rejected as ``duplicate-id``
+    *without* touching the original tenant's event log (conflation is
+    the thing the digests exist to prevent).
+
+    Stop contract (the campaign supervisor's, reused): the FIRST SIGINT
+    stops intake and drains — the executor finishes in-flight dispatches
+    and every unfinished lane gets an attributed "stop requested (drain)"
+    results.jsonl record, so nothing the daemon accepted ever exits
+    silently.  A SECOND SIGINT aborts raw.  ``max_idle_polls`` bounds
+    the idle loop for smoke tests (None = run until signalled).
+    """
+    import signal
+    import threading
+
+    if not os.path.isdir(source):
+        print(f"Error: --watch needs a queue directory, got {source!r}",
+              file=sys.stderr)
+        return 1
+
+    def say(msg: str) -> None:
+        if not quiet:
+            print(msg, flush=True)
+
+    stop = threading.Event()
+    prev = signal.getsignal(signal.SIGINT)
+
+    def handler(_signum, _frame):
+        if stop.is_set():
+            signal.signal(signal.SIGINT, prev)
+            raise KeyboardInterrupt
+        stop.set()
+        print("SIGINT: draining — in-flight lanes get attributed "
+              "records (SIGINT again aborts raw)", file=sys.stderr,
+              flush=True)
+
+    main_thread = threading.current_thread() is threading.main_thread()
+    if main_thread:
+        signal.signal(signal.SIGINT, handler)
+    try:
+        done: set[str] = set()          # file names fully handled
+        attempts: dict[str, int] = {}   # unreadable-file retry counts
+        served_ids: set[str] = set()
+        idle = 0
+        say(f"watching {source} (poll {poll_s:g}s) -> "
+            f"{out_dir}/results.jsonl")
+        while not stop.is_set():
+            try:
+                fresh = sorted(n for n in os.listdir(source)
+                               if n.endswith(".json") and n not in done)
+            except OSError as e:
+                print(f"Error: queue directory unreadable: {e}",
+                      file=sys.stderr)
+                return 1
+            batch, extra_records = [], []
+            for name in fresh:
+                if stop.is_set():
+                    break               # drain: no new intake
+                skipped: list = []
+                try:
+                    jobs = load_jobs(source, skipped=skipped, only=[name])
+                except (OSError, ValueError) as e:
+                    # structurally bad (unsafe id, ...): reject for good
+                    done.add(name)
+                    extra_records.append(
+                        {"job_id": name[:-len(".json")],
+                         "status": "rejected", "reason": "bad-job-file",
+                         "error": str(e)})
+                    continue
+                if skipped:             # torn read: retry a few polls
+                    attempts[name] = attempts.get(name, 0) + 1
+                    if attempts[name] >= 3:
+                        done.add(name)
+                        extra_records.append(
+                            {"job_id": name[:-len(".json")],
+                             "status": "rejected",
+                             "reason": "unreadable-job-file",
+                             "error": skipped[0][1]})
+                    continue
+                done.add(name)
+                for job in jobs:
+                    if job.job_id in served_ids:
+                        extra_records.append(
+                            {"job_id": job.job_id, "status": "rejected",
+                             "reason": "duplicate-id",
+                             "error": "job id already served by this "
+                                      "daemon; events log belongs to "
+                                      "the first submission"})
+                        continue
+                    served_ids.add(job.job_id)
+                    batch.append(job)
+            if extra_records:
+                for rec in extra_records:
+                    say(f"[{rec['job_id']}] rejected ({rec['reason']})")
+                _append_records(out_dir, extra_records)
+            if batch:
+                idle = 0
+                run_service(batch, out_dir, chunk=chunk,
+                            max_states=max_states, quiet=quiet,
+                            depth=depth, stop=stop.is_set)
+                continue                # re-scan immediately after a batch
+            if stop.is_set():
+                break
+            idle += 1
+            if max_idle_polls is not None and idle >= max_idle_polls:
+                say(f"idle for {idle} poll(s) — exiting (--max-idle-polls)")
+                break
+            # sleep in small increments so SIGINT turns around fast
+            deadline = time.monotonic() + poll_s
+            while time.monotonic() < deadline and not stop.is_set():
+                time.sleep(min(0.05, poll_s))
+        say(f"daemon exit: {len(served_ids)} job(s) served"
+            + (" (drained on SIGINT)" if stop.is_set() else ""))
+        return 0
+    finally:
+        if main_thread:
+            signal.signal(signal.SIGINT, prev)
+
+
 def build_argparser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="raft-tla-serve",
@@ -261,6 +417,27 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="per-lane distinct-state cap; an exceeding lane "
                         "is stopped (attributed in its event log), the "
                         "other tenants keep running")
+    p.add_argument("--depth", type=int, default=2,
+                   help="dispatch pipeline depth: how many fused steps "
+                        "may be in flight while earlier harvests run on "
+                        "the host (1 = sequential; default 2)")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent JAX compilation-cache directory "
+                        "(also via RAFT_TLA_COMPILE_CACHE); warm-starts "
+                        "bin compiles across service restarts")
+    p.add_argument("--watch", action="store_true",
+                   help="daemon mode: SOURCE must be a queue directory; "
+                        "keep polling it for new *.json job files and "
+                        "serve each arrival burst as one interleaved "
+                        "batch; first SIGINT drains losslessly, second "
+                        "aborts")
+    p.add_argument("--poll", type=float, default=2.0, metavar="SECS",
+                   help="--watch poll interval (default 2.0)")
+    p.add_argument("--max-idle-polls", type=int, default=None,
+                   metavar="N",
+                   help="--watch: exit 0 after N consecutive empty "
+                        "polls (smoke-test bound; default: run until "
+                        "SIGINT)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend")
     p.add_argument("--quiet", action="store_true",
@@ -279,6 +456,15 @@ def main(argv=None) -> int:
                 print("Warning: --cpu requested but JAX backends are "
                       f"already initialized on {jax.default_backend()!r}; "
                       "proceeding there", file=sys.stderr)
+    from raft_tla_tpu.serve.sched import enable_compile_cache
+    cache_dir = enable_compile_cache(args.compile_cache)
+    if cache_dir and not args.quiet:
+        print(f"compile cache: {cache_dir}")
+    if args.watch:
+        return run_daemon(args.source, args.out, chunk=args.chunk,
+                          max_states=args.max_states, quiet=args.quiet,
+                          depth=args.depth, poll_s=args.poll,
+                          max_idle_polls=args.max_idle_polls)
     skipped: list = []
     try:
         jobs = load_jobs(args.source, skipped=skipped)
@@ -289,7 +475,8 @@ def main(argv=None) -> int:
         print(f"Warning: skipped unreadable job file {name}: {err}",
               file=sys.stderr)
     records = run_service(jobs, args.out, chunk=args.chunk,
-                          max_states=args.max_states, quiet=args.quiet)
+                          max_states=args.max_states, quiet=args.quiet,
+                          depth=args.depth)
     n_by = {}
     for rec in records:
         n_by[rec["status"]] = n_by.get(rec["status"], 0) + 1

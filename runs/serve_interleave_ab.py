@@ -1,0 +1,168 @@
+"""Serve dispatch-interleaving A/B (ISSUE 13 acceptance gate).
+
+Claim under test: routing the serve/ executor's bin dispatches through
+the async :class:`~raft_tla_tpu.serve.sched.DispatchScheduler` —
+two-deep pipelined dispatch, speculative same-bin chunks, and bin
+compiles moved to background threads — (a) leaves every lane's counts
+and verdict byte-identical to a solo ``engine.Engine`` run of the same
+cfg ON EVERY REP, and (b) delivers >= 1.15x the sequential baseline's
+aggregate throughput on a multi-bin manifest.  The baseline arm is the
+same executor at ``depth=1, compile_async=False`` — byte-for-byte the
+PR 6 synchronous dispatch order — so the A/B isolates exactly the
+pipelining + async-compile delta.
+
+Protocol (RESULTS.md "sig-prune A/B" discipline): arms interleave
+round-robin inside each rep so machine drift hits both equally, and
+every arm measurement carries a fiducial (synthetic jitted step + 64 MB
+device copy timed immediately before the arm) so a drifted rep is
+visible in the artifact instead of silently biasing a mean.  Parity vs
+the solo Engine references is asserted for BOTH arms on every rep, not
+sampled.
+
+Manifest: the PR 6 16-job/4-bin manifest (3,014-state toy x8, its
+Server-symmetry quotient x4, a max_term=3 widening x2, a max_msgs=3
+widening x2) — all-completing, so full byte-parity is well-defined.
+
+Usage: python runs/serve_interleave_ab.py [reps]   (default 3)
+Appends one JSON line per arm-rep + a summary to
+runs/serve_interleave_ab.out.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.engine import Engine
+from raft_tla_tpu.serve.batch import BatchExecutor, bin_key
+
+RUNS = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(RUNS, "serve_interleave_ab.out")
+
+CHUNK = 256                           # shared dispatch width, both arms
+
+
+def _cfg(**kw):
+    b = dict(n_servers=2, n_values=1, max_term=2, max_log=0, max_msgs=2)
+    sym = kw.pop("symmetry", ())
+    b.update(kw)
+    return CheckConfig(bounds=Bounds(**b), spec="election",
+                       invariants=("NoTwoLeaders",), symmetry=sym,
+                       chunk=CHUNK)
+
+
+TOY = _cfg()                          # 3,014 states, diameter 17
+TOY_SYM = _cfg(symmetry=("Server",))  # its symmetry quotient
+TOY_T3 = _cfg(max_term=3)             # term-widened universe
+TOY_M3 = _cfg(max_msgs=3)             # channel-widened universe
+
+JOBS = ([(f"toy-{i}", TOY) for i in range(8)]
+        + [(f"sym-{i}", TOY_SYM) for i in range(4)]
+        + [(f"t3-{i}", TOY_T3) for i in range(2)]
+        + [(f"m3-{i}", TOY_M3) for i in range(2)])
+
+ARMS = {
+    # the PR 6 synchronous order: one dispatch in flight, lazy compiles
+    "sequential": dict(depth=1, compile_async=False),
+    # the tentpole: two-deep pipeline, AOT compiles on worker threads
+    "interleaved": dict(depth=2, compile_async=True),
+}
+
+
+def fiducial() -> dict:
+    """Synthetic step + copy, jitted and timed warm (chip/CPU weather)."""
+    x = jnp.arange(1 << 24, dtype=jnp.uint32)          # 64 MB
+
+    @jax.jit
+    def step(v):
+        return (v * jnp.uint32(2654435761) ^ (v >> 7)).sum()
+
+    step(x).block_until_ready()                        # compile
+    t0 = time.monotonic()
+    step(x).block_until_ready()
+    step_ms = (time.monotonic() - t0) * 1e3
+    t0 = time.monotonic()
+    jnp.array(x, copy=True).block_until_ready()
+    copy_ms = (time.monotonic() - t0) * 1e3
+    return {"synthetic_step_ms": round(step_ms, 2),
+            "copy_64mb_ms": round(copy_ms, 2)}
+
+
+def run_arm(arm: str) -> tuple:
+    t0 = time.monotonic()
+    ex = BatchExecutor(chunk=CHUNK, **ARMS[arm])
+    out = ex.run(JOBS)
+    wall = time.monotonic() - t0
+    assert all(oc.status == "completed" for oc in out.values()), \
+        {j: oc.status for j, oc in out.items()}
+    return wall, {jid: oc.result for jid, oc in out.items()}, \
+        ex.last_stats
+
+
+def assert_parity(solo: dict, got: dict, arm: str) -> int:
+    total = 0
+    for jid, _cfg_ in JOBS:
+        a, b = solo[jid], got[jid]
+        for field in ("n_states", "diameter", "n_transitions"):
+            assert getattr(a, field) == getattr(b, field), \
+                (arm, jid, field, getattr(a, field), getattr(b, field))
+        assert list(a.levels) == list(b.levels), (arm, jid)
+        assert dict(a.coverage) == dict(b.coverage), (arm, jid)
+        assert a.complete and b.complete and a.violation is None \
+            and b.violation is None, (arm, jid)
+        total += a.n_states
+    return total
+
+
+def main():
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    n_bins = len({bin_key(cfg) for _jid, cfg in JOBS})
+    # solo Engine references once (deterministic): the parity target
+    # both arms must hit on every rep
+    solo = {jid: Engine(cfg).check() for jid, cfg in JOBS}
+    walls: dict = {a: [] for a in ARMS}
+    n_total = None
+    with open(OUT, "a") as out:
+        for rep in range(reps):
+            for arm in ARMS:            # interleaved: drift is shared
+                fid = fiducial()
+                wall, results, stats = run_arm(arm)
+                walls[arm].append(wall)
+                n_total = assert_parity(solo, results, arm)
+                line = {"rep": rep, "arm": arm, "wall_s": round(wall, 2),
+                        "jobs": len(JOBS), "bins": n_bins,
+                        "dispatches": stats["dispatches"],
+                        "peak_inflight": stats["peak_inflight"],
+                        "async_compiles": stats["async_compiles"],
+                        "platform": jax.default_backend(), **fid}
+                print(json.dumps(line))
+                out.write(json.dumps(line) + "\n")
+                out.flush()
+        med = {a: statistics.median(w) for a, w in walls.items()}
+        rate = {a: round(n_total / med[a], 1) for a in med}
+        ratio = rate["interleaved"] / rate["sequential"]
+        summary = {
+            "summary": "serve_interleave_ab",
+            "jobs": len(JOBS), "bins": n_bins, "chunk": CHUNK,
+            "aggregate_states": n_total,
+            "reps": reps,
+            "parity": "byte-identical to solo on every rep, both arms",
+            "median_wall_s": {a: round(m, 2) for a, m in med.items()},
+            "aggregate_states_per_sec": rate,
+            "interleaved_over_sequential": round(ratio, 4),
+            "pass_ge_1.15": ratio >= 1.15,
+        }
+        print(json.dumps(summary))
+        out.write(json.dumps(summary) + "\n")
+
+
+if __name__ == "__main__":
+    main()

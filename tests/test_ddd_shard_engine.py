@@ -334,3 +334,37 @@ def test_full_spec_small_parity_8dev():
     assert_totals(got, ref)
     for fam in (S.RESTART, S.DUPLICATE, S.DROP):
         assert got.coverage[fam] > 0
+
+
+def test_sigint_window_boundary_stop_and_resume(tmp_path):
+    """ROADMAP item 8 leftover, chaos-tested in-process: the graceful
+    SIGINT contract now reaches the ddd-shard child.  The flag is
+    tripped mid-run (exactly what the installed handler does on the
+    first Ctrl-C); the engine must stop at the next WINDOW boundary —
+    the only point where the canonical shard-major stream is whole —
+    snapshot there, return complete=False with no phantom violation,
+    and the resumed run must land byte-identical to the uninterrupted
+    one (states, levels, transitions, diameter, coverage)."""
+    caps = DDDShardCapacities(block=32, table=1 << 14, seg_rows=1 << 14,
+                              flush=1 << 10, levels=64)
+    mesh = make_mesh(8)
+    straight = DDDShardEngine(CFG, mesh, caps).check()
+    ck = str(tmp_path / "sig.ck")
+    eng = DDDShardEngine(CFG, mesh, caps)
+    fired = {}
+
+    def chaos(snap):
+        if snap["n_states"] > 300 and not fired:
+            fired["at"] = snap["n_states"]
+            eng._sigint = True        # what the first SIGINT sets
+
+    partial = eng.check(on_progress=chaos, checkpoint=ck,
+                        checkpoint_every_s=1e9)
+    assert fired, "chaos hook never fired — model too small"
+    assert partial.complete is False
+    assert partial.violation is None
+    assert partial.n_states < straight.n_states
+    resumed = DDDShardEngine(CFG, mesh, caps).check(resume=ck)
+    assert resumed.complete is True
+    assert_totals(resumed, straight)
+    assert resumed.coverage == straight.coverage

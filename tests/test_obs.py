@@ -140,7 +140,8 @@ def test_validate_rejects_unknowns_and_type_drift():
     assert validate_event({**ok, "level": True})            # bool is not int
     assert validate_event({**ok, "v": 2}) == []             # v2 superset
     assert validate_event({**ok, "v": 3}) == []             # v3 superset
-    assert validate_event({**ok, "v": 4})                   # future version
+    assert validate_event({**ok, "v": 4}) == []             # v4 superset
+    assert validate_event({**ok, "v": 5})                   # future version
     assert validate_event({"v": 1, "event": "level_end", "ts": 0.0,
                            "level": 3})                     # missing field
 
@@ -160,6 +161,23 @@ def test_validate_v2_supervisor_events():
                            "quarantined": "x.ckpt"}) == []
     assert validate_event({"v": 2, "event": "resume_attempt", "ts": 0.0,
                            "attempt": 1, "surprise": 1})    # unknown field
+
+
+def test_validate_v4_serve_segment_fields():
+    """The serve scheduler's per-bin attribution (``bin``/``inflight``
+    on segment events) exists only from schema v4 — field-gated exactly
+    like the v3 fleet fields, so a v3 consumer never sees them."""
+    seg = {"v": 4, "event": "segment", "ts": 0.0, "wall_s": 0.1,
+           "n_states": 10, "level": 1, "n_transitions": 20,
+           "dedup_hit_rate": 0.5, "since_resume": False,
+           "states_per_sec": 100.0, "inc_states_per_sec": 100.0,
+           "bin": "bin0", "inflight": 2}
+    assert validate_event(seg) == []
+    errs = validate_event({**seg, "v": 3})   # v4-only fields, v3 line
+    assert errs and all("requires schema version >= 4" in e
+                        for e in errs)
+    assert validate_event({**seg, "bin": 0})         # type drift
+    assert validate_event({**seg, "inflight": 1.5})  # type drift
 
 
 def test_append_event_validates(tmp_path):

@@ -62,6 +62,30 @@ print(f"serve smoke ok: 2 jobs x {recs[0]['n_states']} states, "
       "per-tenant event logs valid")
 PY
 
+echo "== serve daemon smoke (watch-dir intake -> SIGINT drain, CPU) =="
+mkdir -p "$SERVE_TMP/queue"
+python -m raft_tla_tpu.serve "$SERVE_TMP/queue" --watch \
+    --out "$SERVE_TMP/dout" --chunk 64 --poll 0.2 --cpu --quiet &
+DAEMON_PID=$!
+cat > "$SERVE_TMP/queue/001-watched.json" <<'JOB'
+{"id": "watched", "cfg": "../toy.cfg", "spec": "election", "max_term": 2, "max_log": 0, "max_msgs": 1}
+JOB
+for _ in $(seq 1 600); do
+    grep -q '"job_id": "watched"' "$SERVE_TMP/dout/results.jsonl" \
+        2>/dev/null && break
+    kill -0 "$DAEMON_PID" 2>/dev/null || { echo "daemon died early"; exit 1; }
+    sleep 0.3
+done
+kill -INT "$DAEMON_PID"
+wait "$DAEMON_PID" || { echo "daemon SIGINT drain exited nonzero"; exit 1; }
+python - "$SERVE_TMP/dout" <<'PY'
+import json, sys
+recs = [json.loads(l) for l in open(f"{sys.argv[1]}/results.jsonl")]
+(rec,) = [r for r in recs if r["job_id"] == "watched"]
+assert rec["status"] == "completed" and rec["n_states"] == 524, rec
+print("serve daemon smoke ok: watch intake served, SIGINT drained clean")
+PY
+
 echo "== frontend smoke (two-phase commit through the spec compiler, CPU) =="
 cat > "$SERVE_TMP/2pc.cfg" <<'CFG'
 SPECIFICATION Spec
