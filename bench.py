@@ -104,7 +104,13 @@ def run_fiducial() -> None:
     - a saturating elementwise uint32 loop measuring the chip's
       achievable VPU word rate NOW — the denominator for
       ``pct_vpu_peak`` (a measured ceiling, not a datasheet constant,
-      so the ratio cancels chip weather by construction).
+      so the ratio cancels chip weather by construction);
+    - ``flush_keys_per_sec``: host-only master-key dedup rate at a
+      pinned flush shape (64 flushes of 2^16 pseudorandom keys, ~50%
+      duplicates, through the flat single-thread MasterKeys — gate
+      pinned off) so host-dedup deltas are code-attributable next to
+      ``copy_512mb_ms``: if this fiducial moved, the host was the
+      weather, not the keyset.
 
     ``words_per_sec`` is the orbit scan's analytic word traffic
     (chunk * actions * |G| * packed width) over the synthetic step
@@ -117,6 +123,7 @@ def run_fiducial() -> None:
     os.environ["RAFT_TLA_PRESCAN"] = "off"
     os.environ["RAFT_TLA_SIGPRUNE"] = "off"
     os.environ["RAFT_TLA_MEGAKERNEL"] = "off"
+    os.environ["RAFT_TLA_HOSTDEDUP"] = "off"
     # the compile_wall_ms probe must measure a REAL XLA build: a warm
     # persistent compilation cache (serve/sched.enable_compile_cache,
     # RAFT_TLA_COMPILE_CACHE) would turn it into a disk-read fiducial.
@@ -192,6 +199,24 @@ def run_fiducial() -> None:
     G = math.factorial(bounds.n_servers)
     words_per_sec = chunk * A * G * width / (step_ms / 1e3)
 
+    # -- pinned host master-key dedup rate ---------------------------------
+    # Flat single-thread MasterKeys on a fixed pseudorandom stream (key
+    # pool = 2x total keys => ~50% flush-over-flush duplicates, LSM
+    # compactions included) — pure host CPU + memory bandwidth.
+    from raft_tla_tpu.utils import keyset as _keyset
+    _FLUSH, _NFLUSH = 1 << 16, 64
+    rng = np.random.default_rng(0)
+    flushes = [rng.integers(0, _FLUSH * _NFLUSH * 2, _FLUSH,
+                            dtype=np.int64).astype(np.uint64)
+               for _ in range(_NFLUSH)]
+    _m = _keyset.MasterKeys()                            # warm once
+    _m.dedup(flushes[0].copy())
+    t_f = time.monotonic()
+    m = _keyset.MasterKeys()
+    for f in flushes:
+        m.dedup(f)
+    flush_keys_per_sec = _FLUSH * _NFLUSH / (time.monotonic() - t_f)
+
     print(json.dumps({
         "copy_512mb_ms": round(copy_ms, 2),
         "compile_wall_ms": round(compile_ms, 1),
@@ -199,6 +224,7 @@ def run_fiducial() -> None:
         "words_per_sec": round(words_per_sec, 1),
         "pct_vpu_peak": round(100.0 * words_per_sec / peak_words_per_sec,
                               2),
+        "flush_keys_per_sec": round(flush_keys_per_sec, 1),
     }))
 
 

@@ -51,6 +51,10 @@ DEFAULT_TARGETS = (
     "raft_tla_tpu/frontend",
     "raft_tla_tpu/fleet",
     "raft_tla_tpu/simulate.py",
+    # host-dedup layer: pure NumPy/threading, but it runs interleaved
+    # with the jit harvest loop — keep it under the same hazard lint
+    "raft_tla_tpu/utils/keyset.py",
+    "raft_tla_tpu/utils/flushq.py",
 )
 
 _NARROW_DTYPES = {"int8", "int16", "uint8", "uint16", "bfloat16", "float16",

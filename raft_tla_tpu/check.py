@@ -227,6 +227,19 @@ def build_argparser() -> argparse.ArgumentParser:
                         "decision; default: leave the env/auto policy "
                         "alone (auto is currently OFF — RESULTS.md "
                         "'Megakernel A/B')")
+    p.add_argument("--host-dedup", default=None,
+                   choices=("auto", "on", "off"),
+                   help="partitioned + background host dedup for the ddd "
+                        "engines: the master key set splits into 2^k "
+                        "high-bit partitions with budgeted compaction (no "
+                        "O(N) merge spike in any single flush) and the "
+                        "flush runs on a depth-1 ordered worker thread "
+                        "that overlaps device compute — discovery stays "
+                        "byte-identical (utils/keyset.py has the ordering "
+                        "argument). Sets RAFT_TLA_HOSTDEDUP process-wide; "
+                        "default: leave the env/auto policy alone (auto "
+                        "= on iff nproc >= 2 — RESULTS.md 'Host dedup "
+                        "A/B')")
     p.add_argument("--lint", default="warn", choices=("warn", "strict"),
                    help="static width-safety pass (analysis/widthcheck) "
                         "before any step build: prove no transition can "
@@ -254,7 +267,9 @@ def build_argparser() -> argparse.ArgumentParser:
                         "useful for single checks too")
     p.add_argument("--phase-timers", action="store_true",
                    help="attribute wall time to search phases (upload/"
-                        "expand/export/dedup/snapshot) in each segment "
+                        "expand/export/dedup/snapshot, plus dedup_submit/"
+                        "dedup_wait when background host dedup is on) in "
+                        "each segment "
                         "event, at the cost of a device sync per phase — "
                         "the ddd engines lose their two-deep dispatch "
                         "overlap while this is on. Off by default so jit "
@@ -606,6 +621,11 @@ def main(argv=None) -> int:
         # time (ops/kernels._megakernel_enabled) by every engine family.
         import os
         os.environ["RAFT_TLA_MEGAKERNEL"] = args.megakernel
+    if args.host_dedup is not None:
+        # Same contract: resolved at engine construction
+        # (utils/keyset.host_dedup_enabled) by the ddd engine families.
+        import os
+        os.environ["RAFT_TLA_HOSTDEDUP"] = args.host_dedup
     from raft_tla_tpu.serve.sched import enable_compile_cache
     enable_compile_cache(args.compile_cache)
     _DEVICE_ENGINES = ("device", "paged", "streamed", "ddd", "shard",

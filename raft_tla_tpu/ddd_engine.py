@@ -74,6 +74,7 @@ from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym_mod
 from raft_tla_tpu.utils import ckpt
+from raft_tla_tpu.utils import flushq
 from raft_tla_tpu.utils import keyset
 from raft_tla_tpu.utils import native
 from raft_tla_tpu.utils import pacing
@@ -919,10 +920,26 @@ class DDDEngine:
         self._digest_caps = _DigestCaps(block=self.caps.block,
                                         levels=self.caps.levels)
         self.schema = bitpack.BitSchema(self.bounds)
+        # RAFT_TLA_HOSTDEDUP gate: partitioned master keys + background
+        # flush worker.  Resolved once at construction (like the
+        # sig-prune/megakernel gates) and deliberately NOT part of
+        # _DigestCaps — checkpoints are compatible both directions.
+        self._host_dedup = keyset.host_dedup_enabled()
+        # Per-flush, per-partition merge budget: 8x the partition's
+        # expected share of one flush covers the amortized LSM movement
+        # (flush/parts keys in, each moved ~log2(N/flush) ~ 7 times at
+        # campaign scale) while bounding any single flush's spike.
+        self._merge_budget = max(1 << 16,
+                                 (8 * self.caps.flush)
+                                 // keyset.DEFAULT_PARTS)
         self._segment = jax.jit(
             _build_segment(config, self.caps, self.A, self.lay.width,
                            self.schema),
             donate_argnums=(0, 1))
+
+    def _new_master(self):
+        return keyset.new_master(self._host_dedup,
+                                 merge_budget=self._merge_budget)
 
     def _init_filter(self) -> FilterCarry:
         TB = self.caps.table // BUCKET
@@ -998,7 +1015,13 @@ class DDDEngine:
          blocks_done) = load(path, self.schema.P, digest)
         kw = keystore.read(0, n_states).view(np.uint32)
         keys = keyset.pack_keys(kw[:, 1], kw[:, 0])
-        master = keyset.MasterKeys(np.sort(keys))
+        # master_from_keys dedupe-checks BEFORE construction: a corrupt
+        # log raises the stream-corrupt diagnostic naming the snapshot,
+        # not MasterKeys's generic sortedness error; the partitioned
+        # build also splits the O(N log N) resume sort across the pool
+        master = keyset.master_from_keys(
+            keys, source=path, partitioned=self._host_dedup,
+            merge_budget=self._merge_budget)
         if len(master) != n_states:
             raise ValueError(
                 f"checkpoint key log has {len(master)} distinct keys for "
@@ -1112,7 +1135,7 @@ class DDDEngine:
                 host = native.make_store(self.schema.P)
                 constore = native.make_store(1)
                 keystore = native.make_store(2)
-            master = keyset.MasterKeys()
+            master = self._new_master()
             master.seed(int(keyset.pack_keys(
                 np.uint32(hi0)[None], np.uint32(lo0)[None])[0]))
             init_packed = self.schema.pack(
@@ -1140,6 +1163,36 @@ class DDDEngine:
         bufsets = [self._make_bufs(), self._make_bufs()]
         pend = {"keys": [], "rows": [], "par": [],  # resume starts empty
                 "lane": [], "con": []}
+        # Background dedup worker (RAFT_TLA_HOSTDEDUP): flushes run on
+        # one daemon thread, depth-1 ordered, so flush i's new keys are
+        # in the master before flush i+1's dedup starts — cross-flush
+        # first-occurrence order is untouched and discovery stays byte-
+        # identical.  Every reader of flush-mutated state (block upload,
+        # checkpoint, level boundary, terminal/stop paths) drains first.
+        worker = flushq.DedupWorker(
+            lambda batch: self._flush(batch, master, host, constore,
+                                      keystore, cov)) \
+            if self._host_dedup else None
+        if worker is not None:
+            _cleanup.callback(worker.close)
+
+        def seal(p):
+            batch = {k: v[:] for k, v in p.items()}
+            for v in p.values():
+                v.clear()
+            return batch
+
+        def flush_sync():
+            """Drain the background queue, then flush the remaining pend
+            inline — afterwards master/stores/cov reflect every streamed
+            candidate, exactly as in the synchronous engine."""
+            nonlocal n_states
+            if worker is not None:
+                with tel.phases.phase("dedup_wait"):
+                    n_states += worker.drain()
+            with tel.phases.phase("dedup"):
+                n_states += self._flush(pend, master, host, constore,
+                                        keystore, cov)
         Fcap = self.caps.block
         viol = None          # (kind, inv_idx, dead_g) once detected
         viol_key = None
@@ -1164,11 +1217,14 @@ class DDDEngine:
             # its incremental rate on the running max of this count, so a
             # post-flush dip never reads as a negative rate
             n_incl = n_states + sum(len(k) for k in pend["keys"])
+            if worker is not None:
+                n_incl += worker.inclusive_extra()
             tel.segment(
                 n_states=n_states, n_incl=n_incl,
                 level=len(level_ends), n_transitions=n_trans,
                 coverage=dict(aggregate_coverage(self.table, cov)),
-                route_peak=route_peak)
+                route_peak=route_peak,
+                flush_backlog=worker.backlog() if worker else None)
 
         n_trans_mark = n_trans   # n_trans as of the current block's start
         while not stopped:
@@ -1178,6 +1234,12 @@ class DDDEngine:
                                  Fcap):
                 b_rows = min(Fcap, lvl_hi - b_start)
                 n_trans_mark = n_trans
+                if worker is not None:
+                    # the native stores are not assumed safe for
+                    # concurrent append+read — settle the in-flight
+                    # flush before reading the block
+                    with tel.phases.phase("dedup_wait"):
+                        n_states += worker.drain()
                 with tel.phases.phase("upload") as ph:
                     blk = host.read(b_start, b_rows)
                     con = constore.read(b_start, b_rows)[:, 0].astype(bool)
@@ -1300,10 +1362,23 @@ class DDDEngine:
                     block_done = block_done or bool(st_h.done)
                     if sum(len(x) for x in pend["keys"]) >= \
                             self.caps.flush:
-                        with tel.phases.phase("dedup"):
-                            n_states += self._flush(pend, master, host,
-                                                    constore, keystore,
-                                                    cov)
+                        if worker is not None:
+                            # sealed-batch submission: blocks only until
+                            # the PREVIOUS flush completes (depth-1);
+                            # this one runs while the next segment
+                            # computes.  n_states lags by at most one
+                            # in-flight flush — the _IDX_CEIL re-check
+                            # at every drain point keeps the ceiling
+                            # honest.
+                            n_pend = sum(len(x) for x in pend["keys"])
+                            with tel.phases.phase("dedup_submit"):
+                                worker.submit(seal(pend), n_pend)
+                            n_states += worker.collect()
+                        else:
+                            with tel.phases.phase("dedup"):
+                                n_states += self._flush(pend, master,
+                                                        host, constore,
+                                                        keystore, cov)
                         if n_states > _IDX_CEIL:
                             fail = FAIL_INDEX
                             stopped = True
@@ -1317,9 +1392,7 @@ class DDDEngine:
                 blocks_done += 1
                 if checkpoint and (time.monotonic() - last_ckpt
                                    >= checkpoint_every_s):
-                    with tel.phases.phase("dedup"):
-                        n_states += self._flush(pend, master, host,
-                                                constore, keystore, cov)
+                    flush_sync()
                     with tel.phases.phase("snapshot"):
                         self.save_checkpoint(checkpoint, host, constore,
                                              keystore, n_states, n_trans,
@@ -1330,9 +1403,7 @@ class DDDEngine:
             if stopped:
                 break
             blocks_done = 0
-            with tel.phases.phase("dedup"):
-                n_states += self._flush(pend, master, host, constore,
-                                        keystore, cov)
+            flush_sync()
             progress()
             if n_states > _IDX_CEIL:
                 fail = FAIL_INDEX
@@ -1356,9 +1427,7 @@ class DDDEngine:
                     f"DDD search aborted: {decode_fail(FAIL_LEVEL)} "
                     f"(caps={self.caps}) — grow DDDCapacities and rerun")
 
-        with tel.phases.phase("dedup"):
-            n_states += self._flush(pend, master, host, constore, keystore,
-                                    cov)
+        flush_sync()
         if not complete and checkpoint and not viol and not fail:
             # graceful stop (SIGINT or deadline): same mid-level snapshot
             # shape as the periodic path above (pend flushed first, so

@@ -25,7 +25,8 @@ wall).  This module replaces all of that with:
   ``level_end`` derived automatically from level transitions and
   ``violation`` derived from the final :class:`~raft_tla_tpu.engine.EngineResult`.
 
-Event grammar (``SCHEMA_VERSION`` = 3; version-1/2 lines remain valid) —
+Event grammar (``SCHEMA_VERSION`` = 5; earlier-version lines remain
+valid) —
 every line is one JSON object with base fields ``v`` (schema version),
 ``event`` (type) and ``ts`` (unix epoch seconds):
 
@@ -71,11 +72,19 @@ both invalid on a ``"v" < 4`` line:
                            segment boundary was observed (0 = the lane
                            ran synchronously)
 
+Version 5 adds the ddd background host-dedup attribution field —
+optional, invalid on a ``"v" < 5`` line:
+
+``segment.flush_backlog``  sealed dedup flushes pending/in flight on the
+                           background worker when the segment boundary
+                           was observed (0/1 — the worker is depth-1
+                           ordered; absent = synchronous host dedup)
+
 A run log with no ``run_end`` means the process died — crash attribution
 for free.  The schema is strict: unknown fields fail validation and the
-v2-only event types (resp. v3/v4-only fields) are invalid on a ``"v": 1``
-(resp. ``"v" < 3`` / ``"v" < 4``) line, so any addition requires a
-version bump (versioning policy in README.md).
+v2-only event types (resp. v3/v4/v5-only fields) are invalid on a
+``"v": 1`` (resp. ``"v" < 3`` / ``"v" < 4`` / ``"v" < 5``) line, so any
+addition requires a version bump (versioning policy in README.md).
 """
 
 from __future__ import annotations
@@ -88,8 +97,8 @@ import subprocess
 import threading
 import time
 
-SCHEMA_VERSION = 4
-_VERSIONS = (1, 2, 3, 4)     # versions validate_event accepts
+SCHEMA_VERSION = 5
+_VERSIONS = (1, 2, 3, 4, 5)  # versions validate_event accepts
 
 # Environment knobs (set by check.py --events/--phase-timers; inherited by
 # liveness re-runs and bench children the same way RAFT_TLA_SIGPRUNE is).
@@ -158,6 +167,10 @@ _V3_FIELDS = {"segment": frozenset({"device_rates"}),
 # per-bin attribution) — invalid on a "v" < 4 line.
 _V4_FIELDS = {"segment": frozenset({"bin", "inflight"})}
 
+# Fields that only exist from schema version 5 on (ddd background
+# host-dedup attribution) — invalid on a "v" < 5 line.
+_V5_FIELDS = {"segment": frozenset({"flush_backlog"})}
+
 _OPTIONAL = {
     "run_start": {"bounds": dict, "symmetry": list, "view": str,
                   "chunk": int, "caps": str, "n_states": int,
@@ -165,7 +178,7 @@ _OPTIONAL = {
                   "pid": int},
     "segment": {"coverage": dict, "route_peak": int, "n_devices": int,
                 "inv_evals": dict, "phase_s": dict, "device_rates": list,
-                "bin": str, "inflight": int},
+                "bin": str, "inflight": int, "flush_backlog": int},
     "level_end": {},
     "checkpoint": {"n_states": int},
     "violation": {"kind": str},
@@ -212,6 +225,7 @@ def validate_event(d: dict) -> list:
             errs.append(f"{ev}: field {k!r} has wrong type")
     v3_only = _V3_FIELDS.get(ev, frozenset())
     v4_only = _V4_FIELDS.get(ev, frozenset())
+    v5_only = _V5_FIELDS.get(ev, frozenset())
     for k, val in d.items():
         if k in _BASE or k in req:
             continue
@@ -224,6 +238,8 @@ def validate_event(d: dict) -> list:
             errs.append(f"{ev}: field {k!r} requires schema version >= 3")
         elif k in v4_only and d["v"] in _VERSIONS and d["v"] < 4:
             errs.append(f"{ev}: field {k!r} requires schema version >= 4")
+        elif k in v5_only and d["v"] in _VERSIONS and d["v"] < 5:
+            errs.append(f"{ev}: field {k!r} requires schema version >= 5")
     return errs
 
 
@@ -262,6 +278,7 @@ class ProgressRecord:
     device_rates: list | None = None  # fleet: per-device walker states/s
     bin: str | None = None            # serve: step-signature bin tag
     inflight: int | None = None       # serve: dispatches in flight
+    flush_backlog: int | None = None  # ddd: background flushes pending
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -306,7 +323,8 @@ class ProgressTracker:
                phase_s: dict | None = None,
                device_rates: list | None = None,
                bin: str | None = None,
-               inflight: int | None = None) -> ProgressRecord:
+               inflight: int | None = None,
+               flush_backlog: int | None = None) -> ProgressRecord:
         wall = time.monotonic() - self.t0
         reported = n_states if n_incl is None else max(n_states, n_incl)
         if self._prev_n is None:  # unknown baseline: anchor, rate 0
@@ -338,6 +356,7 @@ class ProgressTracker:
             device_rates=device_rates,
             bin=bin,
             inflight=inflight,
+            flush_backlog=flush_backlog,
         )
 
 
@@ -529,13 +548,15 @@ class RunTelemetry:
                 n_incl: int | None = None,
                 device_rates: list | None = None,
                 bin: str | None = None,
-                inflight: int | None = None) -> ProgressRecord:
+                inflight: int | None = None,
+                flush_backlog: int | None = None) -> ProgressRecord:
         rec = self.tracker.record(
             n_states, level, n_transitions, coverage=coverage,
             route_peak=route_peak, n_incl=n_incl,
             phase_s=self.phases.snapshot(),
             device_rates=device_rates,
-            bin=bin, inflight=inflight)
+            bin=bin, inflight=inflight,
+            flush_backlog=flush_backlog)
         if self.log is not None:
             if self._last_level is not None and level > self._last_level:
                 # The boundary count is the count as observed at the first

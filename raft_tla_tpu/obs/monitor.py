@@ -217,6 +217,7 @@ def summarize(stream: dict, window_s: float = 600.0,
         "route_peak": cur.get("route_peak"),
         "bin": cur.get("bin"),
         "inflight": cur.get("inflight"),
+        "flush_backlog": cur.get("flush_backlog"),
         "level_sizes": _level_sizes(events, segments),
         "target": target,
         "legacy": stream["legacy"],
@@ -312,6 +313,10 @@ def heartbeat(summary: dict | None) -> str:
         if summary.get("inflight") is not None:
             tag += f" (inflight {summary['inflight']})"
         parts.append(tag)
+    if summary.get("flush_backlog") is not None:
+        # ddd background host dedup: 1 = a sealed flush was overlapping
+        # device compute at the segment boundary (depth-1 worker)
+        parts.append(f"flush backlog {summary['flush_backlog']}")
     if summary.get("last_event_age_s") is not None:
         parts.append(f"last ev {summary['last_event_age_s']:.0f}s ago")
     parts.append(summary["status"])

@@ -22,7 +22,14 @@ field by :meth:`PhaseTimers.snapshot`.
 Phase vocabulary (shared so logs compare across engines): ``upload``
 (host->device frontier/block staging), ``expand`` (the jit segment),
 ``export`` (device->host harvest / pageout), ``dedup`` (host-side exact
-dedup flush, ddd only), ``snapshot`` (checkpoint save).
+dedup flush run inline, ddd only), ``snapshot`` (checkpoint save).
+With background host dedup (``RAFT_TLA_HOSTDEDUP``) the ddd engine
+splits ``dedup`` into ``dedup_submit`` (sealing + handing the batch to
+the depth-1 worker — blocks only while the *previous* flush is still
+running, so a nonzero wall here means the device outran the host dedup)
+and ``dedup_wait`` (drain at a block/checkpoint/level/stop boundary —
+the part of the flush that did NOT overlap device compute), so the
+overlap is attributable, not inferred.
 
 This module is host-path orchestration only — nothing here is ever
 traced (the no-op handle is what jit-adjacent code touches).

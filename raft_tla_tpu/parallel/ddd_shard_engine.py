@@ -443,6 +443,16 @@ class DDDShardEngine:
                                         ndev=self.ndev,
                                         cp=self.caps.cp)
         self.schema = bitpack.BitSchema(self.bounds)
+        # RAFT_TLA_HOSTDEDUP: per-shard masters ride the partitioned
+        # keyset and the process-shared dedup pool.  Shard ownership is
+        # hi mod ndev — orthogonal to the keyset's top-bit partitioning,
+        # so every shard splits evenly.  The flush itself stays
+        # synchronous here: the canonical (level, window, shard) drain
+        # order is fixed at window boundaries, not flush time.
+        self._host_dedup = keyset.host_dedup_enabled()
+        self._merge_budget = max(1 << 16,
+                                 (8 * self.caps.flush)
+                                 // keyset.DEFAULT_PARTS)
         axes = _mesh_axes(self.mesh)
         nici = self.mesh.shape[_AXIS]
         specs = _carry_specs(axes)
@@ -601,22 +611,27 @@ class DDDShardEngine:
             if self.caps.retention == "frontier" else load_ddd_snapshot
         (host, constore, keystore, n_states, n_trans, cov, level_ends,
          blocks_done) = load(path, self.schema.P, digest)
-        masters = self._rebuild_masters(keystore, n_states)
+        masters = self._rebuild_masters(keystore, n_states, source=path)
         return (host, constore, keystore, masters, n_states, n_trans,
                 cov, level_ends, blocks_done)
 
-    def _rebuild_masters(self, keystore, n_states):
+    def _new_master(self):
+        return keyset.new_master(self._host_dedup,
+                                 merge_budget=self._merge_budget)
+
+    def _rebuild_masters(self, keystore, n_states, source="checkpoint"):
         kw = keystore.read(0, n_states).view(np.uint32)
         keys = keyset.pack_keys(kw[:, 1], kw[:, 0])
         own = (kw[:, 1] % np.uint32(self.ndev)).astype(np.int64)
-        masters = []
-        for s in range(self.ndev):
-            part = np.sort(keys[own == s])
-            if part.size and np.any(part[1:] == part[:-1]):
-                raise ValueError(
-                    "checkpoint key log has duplicate keys — stream "
-                    "corrupt")
-            masters.append(keyset.MasterKeys(part))
+        # master_from_keys dedupe-checks per shard and (partitioned)
+        # sorts per partition on the shared pool, naming the snapshot in
+        # the corruption diagnostic
+        masters = [
+            keyset.master_from_keys(
+                keys[own == s], source=source,
+                partitioned=self._host_dedup,
+                merge_budget=self._merge_budget)
+            for s in range(self.ndev)]
         if sum(len(m) for m in masters) != n_states:
             raise ValueError(
                 f"checkpoint key log partitions to "
@@ -710,7 +725,7 @@ class DDDShardEngine:
                 host = native.make_store(self.schema.P)
                 constore = native.make_store(1)
                 keystore = native.make_store(2)
-            masters = [keyset.MasterKeys() for _ in range(self.ndev)]
+            masters = [self._new_master() for _ in range(self.ndev)]
             k0 = int(keyset.pack_keys(np.uint32(hi0)[None],
                                       np.uint32(lo0)[None])[0])
             masters[int(np.uint32(hi0) % np.uint32(self.ndev))].seed(k0)

@@ -65,7 +65,9 @@ def test_capacity_past_device_table_scale():
     assert got.complete
 
 
-def test_violation_trace_replays_and_stops_exactly():
+@pytest.mark.parametrize("host_dedup", ["on", "off"])
+def test_violation_trace_replays_and_stops_exactly(host_dedup, monkeypatch):
+    monkeypatch.setenv("RAFT_TLA_HOSTDEDUP", host_dedup)
     from raft_tla_tpu.models import invariants as inv_mod
     from raft_tla_tpu.models import spec as S
     from raft_tla_tpu.ops import msgbits as mb
@@ -131,7 +133,9 @@ def test_symmetry_composes():
     assert got.coverage == ref.coverage
 
 
-def test_deadlock_detected():
+@pytest.mark.parametrize("host_dedup", ["on", "off"])
+def test_deadlock_detected(host_dedup, monkeypatch):
+    monkeypatch.setenv("RAFT_TLA_HOSTDEDUP", host_dedup)
     cfg = CheckConfig(bounds=Bounds(n_servers=1, n_values=1, max_term=2,
                                     max_log=0, max_msgs=2),
                       spec="election", invariants=(), chunk=16,
@@ -235,6 +239,71 @@ def test_masterkeys_resume_constructor():
     import pytest
     with pytest.raises(ValueError):
         MasterKeys(bad)
+
+
+# -- RAFT_TLA_HOSTDEDUP gate (partitioned + background host dedup) ----------
+
+
+@pytest.mark.parametrize("host_dedup", ["on", "off"])
+def test_host_dedup_oracle_parity_both_arms(host_dedup, monkeypatch):
+    """Explicit both-arm parity (the rest of this file runs under the
+    auto policy): partitioned master keys + depth-1 background flush
+    must not move a single byte of discovery."""
+    monkeypatch.setenv("RAFT_TLA_HOSTDEDUP", host_dedup)
+    ref = refbfs.check(CFG)
+    got = DDDEngine(CFG, CAPS).check()
+    assert got.n_states == ref.n_states == 3014
+    assert got.levels == ref.levels
+    assert got.n_transitions == ref.n_transitions
+    assert got.coverage == ref.coverage
+    assert got.violation is None and got.complete
+
+
+def test_host_dedup_checkpoint_cross_gate(tmp_path, monkeypatch):
+    """Checkpoints are gate-agnostic (the master set is rebuilt from the
+    key log, and the gate is deliberately not part of the digest):
+    written under either arm, resumable under the other, byte-identical
+    finals both ways."""
+    straight = DDDEngine(CFG, CAPS).check()
+    for write, read in (("on", "off"), ("off", "on")):
+        ck = str(tmp_path / f"ddd_{write}.ckpt")
+        monkeypatch.setenv("RAFT_TLA_HOSTDEDUP", write)
+        mid = DDDEngine(CFG, CAPS).check(checkpoint=ck,
+                                         checkpoint_every_s=0.0)
+        assert mid.n_states == straight.n_states
+        monkeypatch.setenv("RAFT_TLA_HOSTDEDUP", read)
+        resumed = DDDEngine(CFG, CAPS).check(resume=ck)
+        assert resumed.n_states == straight.n_states, (write, read)
+        assert resumed.levels == straight.levels
+        assert resumed.n_transitions == straight.n_transitions
+        assert resumed.coverage == straight.coverage
+        assert resumed.violation is None
+
+
+def test_host_dedup_lossless_deadline_stop_with_pending_flush(
+        tmp_path, monkeypatch):
+    """The lossless-stop contract under the async flush: a deadline
+    lands while sealed batches may be in flight on the background
+    worker; the stop path drains the queue before the snapshot, so
+    resume completes byte-identical to an uninterrupted run."""
+    monkeypatch.setenv("RAFT_TLA_HOSTDEDUP", "on")
+    cfg = CheckConfig(bounds=Bounds(n_servers=3, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=1),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=64)
+    caps = DDDCapacities(block=256, table=1 << 14, flush=1 << 9, levels=64)
+    straight = DDDEngine(cfg, caps).check()
+    ck = str(tmp_path / "dl.ckpt")
+    got = DDDEngine(cfg, caps).check(deadline_s=0.5, checkpoint=ck,
+                                     checkpoint_every_s=3600.0)
+    assert not got.complete
+    assert got.n_states < straight.n_states
+    resumed = DDDEngine(cfg, caps).check(resume=ck)
+    assert resumed.complete
+    assert resumed.n_states == straight.n_states
+    assert resumed.levels == straight.levels
+    assert resumed.n_transitions == straight.n_transitions
+    assert resumed.coverage == straight.coverage
 
 
 def test_deadline_stops_cleanly():

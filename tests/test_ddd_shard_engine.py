@@ -43,12 +43,33 @@ def assert_totals(got, ref):
     assert sum(got.coverage.values()) == sum(ref.coverage.values())
 
 
-def test_election_2server_parity_8dev():
+@pytest.mark.parametrize("host_dedup", ["on", "off"])
+def test_election_2server_parity_8dev(host_dedup, monkeypatch):
+    monkeypatch.setenv("RAFT_TLA_HOSTDEDUP", host_dedup)
     ref = refbfs.check(CFG)
     got = DDDShardEngine(CFG, make_mesh(8), CAPS).check()
     assert_totals(got, ref)
     assert got.n_states == 3014 and got.diameter == 17
     assert got.violation is None
+
+
+def test_host_dedup_checkpoint_cross_gate_4dev(tmp_path, monkeypatch):
+    """Per-shard partitioned masters rebuild from the same gate-agnostic
+    key log: a snapshot written under either arm resumes under the
+    other, byte-identical, with the canonical (level, window, shard)
+    order untouched."""
+    mesh = make_mesh(4)
+    straight = DDDShardEngine(CFG, mesh, CAPS).check()
+    for write, read in (("on", "off"), ("off", "on")):
+        ck = str(tmp_path / f"shard_{write}.ckpt")
+        monkeypatch.setenv("RAFT_TLA_HOSTDEDUP", write)
+        DDDShardEngine(CFG, mesh, CAPS).check(checkpoint=ck,
+                                              checkpoint_every_s=0.0)
+        monkeypatch.setenv("RAFT_TLA_HOSTDEDUP", read)
+        resumed = DDDShardEngine(CFG, mesh, CAPS).check(resume=ck)
+        assert_totals(resumed, straight)
+        assert resumed.coverage == straight.coverage
+        assert resumed.violation is None
 
 
 def test_single_dev_mesh_equals_single_chip():

@@ -141,7 +141,8 @@ def test_validate_rejects_unknowns_and_type_drift():
     assert validate_event({**ok, "v": 2}) == []             # v2 superset
     assert validate_event({**ok, "v": 3}) == []             # v3 superset
     assert validate_event({**ok, "v": 4}) == []             # v4 superset
-    assert validate_event({**ok, "v": 5})                   # future version
+    assert validate_event({**ok, "v": 5}) == []             # v5 superset
+    assert validate_event({**ok, "v": 6})                   # future version
     assert validate_event({"v": 1, "event": "level_end", "ts": 0.0,
                            "level": 3})                     # missing field
 
@@ -178,6 +179,23 @@ def test_validate_v4_serve_segment_fields():
                         for e in errs)
     assert validate_event({**seg, "bin": 0})         # type drift
     assert validate_event({**seg, "inflight": 1.5})  # type drift
+
+
+def test_validate_v5_hostdedup_segment_field():
+    """The ddd background host-dedup attribution (``flush_backlog`` on
+    segment events) exists only from schema v5 — field-gated exactly
+    like the v3/v4 fields, so a v4 consumer never sees it."""
+    seg = {"v": 5, "event": "segment", "ts": 0.0, "wall_s": 0.1,
+           "n_states": 10, "level": 1, "n_transitions": 20,
+           "dedup_hit_rate": 0.5, "since_resume": False,
+           "states_per_sec": 100.0, "inc_states_per_sec": 100.0,
+           "flush_backlog": 1}
+    assert validate_event(seg) == []
+    errs = validate_event({**seg, "v": 4})   # v5-only field, v4 line
+    assert errs and all("requires schema version >= 5" in e
+                        for e in errs)
+    assert validate_event({**seg, "flush_backlog": 0.5})  # type drift
+    assert validate_event({**seg, "flush_backlog": True})  # bool ≠ int
 
 
 def test_append_event_validates(tmp_path):

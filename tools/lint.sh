@@ -109,6 +109,30 @@ python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
 grep -q "^3014 distinct states found" "$SERVE_TMP/megakernel.out" \
     || { echo "megakernel smoke FAILED: expected 3014 states"; exit 1; }
 
+echo "== host-dedup smoke (ddd engine, background partitioned flush, CPU) =="
+# Gate forced ON: the toy cfg runs end-to-end through the ddd engine
+# with partitioned master keys and the depth-1 background flush worker,
+# then again with the gate OFF — the result lines (counts, diameter,
+# transitions; wall stripped) must be byte-identical.
+python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
+    --spec election --max-term 2 --max-log 0 --max-msgs 2 \
+    --engine ddd --chunk 32 --host-dedup on --cpu --no-lint --no-trace \
+    | tee "$SERVE_TMP/hostdedup_on.out" | tail -2
+grep -q "^3014 distinct states found" "$SERVE_TMP/hostdedup_on.out" \
+    || { echo "host-dedup smoke FAILED: expected 3014 states"; exit 1; }
+python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
+    --spec election --max-term 2 --max-log 0 --max-msgs 2 \
+    --engine ddd --chunk 32 --host-dedup off --cpu --no-lint --no-trace \
+    > "$SERVE_TMP/hostdedup_off.out"
+on_line="$(grep '^3014 distinct states found' "$SERVE_TMP/hostdedup_on.out" \
+    | sed 's/, [0-9.]*s.*//')"
+off_line="$(grep '^3014 distinct states found' "$SERVE_TMP/hostdedup_off.out" \
+    | sed 's/, [0-9.]*s.*//')"
+[ "$on_line" = "$off_line" ] \
+    || { echo "host-dedup smoke FAILED: on/off result lines differ"; \
+         echo "  on:  $on_line"; echo "  off: $off_line"; exit 1; }
+echo "host-dedup smoke ok: on/off byte-identical ($on_line)"
+
 echo "== chaos smoke (campaign SIGKILL + reshard 1->2->1, CPU) =="
 # The campaign supervisor's acceptance loop in miniature: reference run,
 # then SIGKILL after the 2nd checkpoint, auto-reshard across a 1->2->1
