@@ -111,6 +111,10 @@ def run_fiducial() -> None:
       pinned off) so host-dedup deltas are code-attributable next to
       ``copy_512mb_ms``: if this fiducial moved, the host was the
       weather, not the keyset.
+    - ``store_read_mb_s``: host-store block read bandwidth off a
+      disk-backed FileStore (prefetch gate pinned off), so upload-
+      prefetch deltas are code-attributable rather than page-cache
+      weather.
 
     ``words_per_sec`` is the orbit scan's analytic word traffic
     (chunk * actions * |G| * packed width) over the synthetic step
@@ -124,6 +128,7 @@ def run_fiducial() -> None:
     os.environ["RAFT_TLA_SIGPRUNE"] = "off"
     os.environ["RAFT_TLA_MEGAKERNEL"] = "off"
     os.environ["RAFT_TLA_HOSTDEDUP"] = "off"
+    os.environ["RAFT_TLA_PREFETCH"] = "off"
     # the compile_wall_ms probe must measure a REAL XLA build: a warm
     # persistent compilation cache (serve/sched.enable_compile_cache,
     # RAFT_TLA_COMPILE_CACHE) would turn it into a disk-read fiducial.
@@ -217,6 +222,31 @@ def run_fiducial() -> None:
         m.dedup(f)
     flush_keys_per_sec = _FLUSH * _NFLUSH / (time.monotonic() - t_f)
 
+    # -- pinned host-store block read bandwidth ----------------------------
+    # Disk-backed FileStore (the frontier-retention regime) read back in
+    # 2^16-row blocks, prefetch gate pinned off above — pure host
+    # filesystem/page-cache bandwidth, so prefetch A/B deltas are
+    # code-attributable rather than page-cache weather.
+    import tempfile
+    from raft_tla_tpu.utils import native as _native
+    _W, _BROWS, _NB = 32, 1 << 16, 16
+    srng = np.random.default_rng(1)
+    srows = srng.integers(0, 1 << 31, (_BROWS, _W), dtype=np.int64) \
+        .astype(np.int32)
+    with tempfile.TemporaryDirectory(prefix="bench_store_") as td:
+        fs = _native.FileStore(os.path.join(td, "fid.rows"), _W,
+                               reset=True)
+        for _ in range(_NB):
+            fs.append(srows)
+        fs.sync()
+        fs.read(0, _BROWS)                               # warm once
+        t_r = time.monotonic()
+        for b in range(_NB):
+            fs.read(b * _BROWS, _BROWS)
+        dt_r = time.monotonic() - t_r
+        fs.close()
+    store_read_mb_s = _NB * _BROWS * _W * 4 / (1 << 20) / dt_r
+
     print(json.dumps({
         "copy_512mb_ms": round(copy_ms, 2),
         "compile_wall_ms": round(compile_ms, 1),
@@ -225,6 +255,7 @@ def run_fiducial() -> None:
         "pct_vpu_peak": round(100.0 * words_per_sec / peak_words_per_sec,
                               2),
         "flush_keys_per_sec": round(flush_keys_per_sec, 1),
+        "store_read_mb_s": round(store_read_mb_s, 1),
     }))
 
 
@@ -433,7 +464,8 @@ def main() -> None:
           f"step compile {fid.get('compile_wall_ms', 0.0):,.0f} ms, "
           f"synthetic step {fid['synthetic_step_ms']:.1f} ms, "
           f"{fid['words_per_sec']:,.0f} orbit-words/s "
-          f"({fid['pct_vpu_peak']:.1f}% of measured VPU ceiling)",
+          f"({fid['pct_vpu_peak']:.1f}% of measured VPU ceiling), "
+          f"store read {fid.get('store_read_mb_s', 0.0):,.0f} MB/s",
           file=sys.stderr)
     # -- part 0.6: megakernel probe column ---------------------------------
     # both step builds at the fiducial shape (RESULTS.md "Megakernel

@@ -15,10 +15,23 @@
 // docstring).  Exposed C ABI only; bound via ctypes (no pybind11 in the
 // image).
 //
-// Memory layout: fixed-size blocks (BLOCK_ROWS rows each) held in a vector
-// of unique_ptr — append never reallocates or copies existing rows, so read
-// pointers stay valid across appends and capacity grows to host RAM.
+// Memory layout: fixed-size blocks (BLOCK_ROWS rows each) addressed
+// through a two-level block directory of atomic pointers — append never
+// reallocates or copies existing rows OR the directory itself, so read
+// pointers stay valid across appends and capacity grows to host RAM
+// (2^12 root entries x 2^12 blocks x 2^16 rows = 2^40 rows).
+//
+// Concurrency contract (the upload-prefetch disjointness precondition,
+// utils/prefetch.py): ONE appender thread and any number of reader
+// threads may run concurrently, provided every read targets rows below
+// a size the reader observed via store_size() AFTER those rows were
+// appended.  Appends publish block pointers and then the new n_rows
+// with release stores; store_size() loads with acquire, so a reader
+// that bounds-checks against an observed size sees fully-written rows.
+// Concurrent reads of rows at or above the observed size (and
+// multi-appender use) remain undefined.
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <memory>
@@ -28,28 +41,76 @@ namespace {
 
 constexpr int64_t BLOCK_ROWS = 1 << 16;
 
+// Two-level directory of heap blocks: a fixed root of atomic chunk
+// pointers, each chunk a fixed array of atomic block pointers.  The
+// single appender allocates chunks/blocks on demand and publishes the
+// pointers with release stores; readers load with acquire.  Neither
+// level ever moves, unlike a std::vector's backing array.
+template <typename T>
+struct BlockDir {
+    static constexpr int64_t CHUNK = 1 << 12;  // blocks per chunk
+    static constexpr int64_t ROOT = 1 << 12;   // chunks in the root
+
+    std::atomic<std::atomic<T*>*> root[ROOT] = {};
+
+    ~BlockDir() {
+        for (int64_t c = 0; c < ROOT; ++c) {
+            std::atomic<T*>* chunk =
+                root[c].load(std::memory_order_relaxed);
+            if (!chunk) break;
+            for (int64_t b = 0; b < CHUNK; ++b)
+                delete[] chunk[b].load(std::memory_order_relaxed);
+            delete[] chunk;
+        }
+    }
+
+    // Reader path: acquire loads pair with the appender's release
+    // stores of the same pointers.
+    T* block(int64_t b) const {
+        std::atomic<T*>* chunk =
+            root[b / CHUNK].load(std::memory_order_acquire);
+        return chunk[b % CHUNK].load(std::memory_order_acquire);
+    }
+
+    // Appender path (single thread): allocate-and-publish on demand.
+    T* ensure_block(int64_t b, int64_t elems) {
+        std::atomic<T*>* chunk =
+            root[b / CHUNK].load(std::memory_order_relaxed);
+        if (!chunk) {
+            chunk = new std::atomic<T*>[CHUNK]();
+            root[b / CHUNK].store(chunk, std::memory_order_release);
+        }
+        T* blk = chunk[b % CHUNK].load(std::memory_order_relaxed);
+        if (!blk) {
+            blk = new T[elems];
+            chunk[b % CHUNK].store(blk, std::memory_order_release);
+        }
+        return blk;
+    }
+};
+
 struct Store {
     int32_t width;                // int32 words per state row
-    int64_t n_rows = 0;
-    int64_t n_links = 0;
-    std::vector<std::unique_ptr<int32_t[]>> blocks;        // state rows
+    std::atomic<int64_t> n_rows{0};
+    std::atomic<int64_t> n_links{0};
+    BlockDir<int32_t> blocks;     // state rows
     // Trace links, int64 parents: discovery indices passed 2^31 on the
     // round-3 flagship campaign (983.4M orbits with levels still
     // growing), so the 32-bit link was the binding state-count ceiling
     // of the whole DDD architecture (VERDICT r3 missing #2).
-    std::vector<std::unique_ptr<int64_t[]>> parent_blocks;
-    std::vector<std::unique_ptr<int32_t[]>> lane_blocks;
+    BlockDir<int64_t> parent_blocks;
+    BlockDir<int32_t> lane_blocks;
 
     explicit Store(int32_t w) : width(w) {}
 
-    int32_t* row_ptr(int64_t r) {
-        return blocks[r / BLOCK_ROWS].get() + (r % BLOCK_ROWS) * width;
+    const int32_t* row_ptr(int64_t r) const {
+        return blocks.block(r / BLOCK_ROWS) + (r % BLOCK_ROWS) * width;
     }
-    int64_t* parent_ptr(int64_t r) {
-        return parent_blocks[r / BLOCK_ROWS].get() + (r % BLOCK_ROWS);
+    const int64_t* parent_ptr(int64_t r) const {
+        return parent_blocks.block(r / BLOCK_ROWS) + (r % BLOCK_ROWS);
     }
-    int32_t* lane_ptr(int64_t r) {
-        return lane_blocks[r / BLOCK_ROWS].get() + (r % BLOCK_ROWS);
+    const int32_t* lane_ptr(int64_t r) const {
+        return lane_blocks.block(r / BLOCK_ROWS) + (r % BLOCK_ROWS);
     }
 };
 
@@ -61,18 +122,24 @@ Store* store_create(int32_t width) { return new Store(width); }
 
 void store_destroy(Store* s) { delete s; }
 
-int64_t store_size(const Store* s) { return s->n_rows; }
+int64_t store_size(const Store* s) {
+    return s->n_rows.load(std::memory_order_acquire);
+}
 
-// Append n rows of s->width int32s; returns the new row count.
+// Append n rows of s->width int32s; returns the new row count.  The
+// new size is release-published only after every row is fully written,
+// so concurrent readers bounds-checking against store_size() never see
+// a partially-copied row.
 int64_t store_append(Store* s, const int32_t* rows, int64_t n) {
-    for (int64_t k = 0; k < n; ++k) {
-        if (s->n_rows / BLOCK_ROWS >= (int64_t)s->blocks.size())
-            s->blocks.emplace_back(new int32_t[BLOCK_ROWS * s->width]);
-        std::memcpy(s->row_ptr(s->n_rows), rows + k * s->width,
-                    sizeof(int32_t) * s->width);
-        ++s->n_rows;
+    int64_t r = s->n_rows.load(std::memory_order_relaxed);
+    for (int64_t k = 0; k < n; ++k, ++r) {
+        int32_t* blk = s->blocks.ensure_block(
+            r / BLOCK_ROWS, BLOCK_ROWS * s->width);
+        std::memcpy(blk + (r % BLOCK_ROWS) * s->width,
+                    rows + k * s->width, sizeof(int32_t) * s->width);
     }
-    return s->n_rows;
+    s->n_rows.store(r, std::memory_order_release);
+    return r;
 }
 
 void store_read(Store* s, int64_t start, int64_t n, int32_t* out) {
@@ -82,18 +149,20 @@ void store_read(Store* s, int64_t start, int64_t n, int32_t* out) {
 }
 
 // Trace links: (int64 parent discovery index, int32 action lane).
+// Same publish discipline as store_append.
 int64_t store_append_links(Store* s, const int64_t* parent,
                            const int32_t* lane, int64_t n) {
-    for (int64_t k = 0; k < n; ++k) {
-        if (s->n_links / BLOCK_ROWS >= (int64_t)s->parent_blocks.size()) {
-            s->parent_blocks.emplace_back(new int64_t[BLOCK_ROWS]);
-            s->lane_blocks.emplace_back(new int32_t[BLOCK_ROWS]);
-        }
-        *s->parent_ptr(s->n_links) = parent[k];
-        *s->lane_ptr(s->n_links) = lane[k];
-        ++s->n_links;
+    int64_t r = s->n_links.load(std::memory_order_relaxed);
+    for (int64_t k = 0; k < n; ++k, ++r) {
+        int64_t* pblk = s->parent_blocks.ensure_block(
+            r / BLOCK_ROWS, BLOCK_ROWS);
+        int32_t* lblk = s->lane_blocks.ensure_block(
+            r / BLOCK_ROWS, BLOCK_ROWS);
+        pblk[r % BLOCK_ROWS] = parent[k];
+        lblk[r % BLOCK_ROWS] = lane[k];
     }
-    return s->n_links;
+    s->n_links.store(r, std::memory_order_release);
+    return r;
 }
 
 void store_read_links(Store* s, int64_t start, int64_t n,

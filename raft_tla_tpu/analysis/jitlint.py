@@ -55,6 +55,7 @@ DEFAULT_TARGETS = (
     # with the jit harvest loop — keep it under the same hazard lint
     "raft_tla_tpu/utils/keyset.py",
     "raft_tla_tpu/utils/flushq.py",
+    "raft_tla_tpu/utils/prefetch.py",
 )
 
 _NARROW_DTYPES = {"int8", "int16", "uint8", "uint16", "bfloat16", "float16",

@@ -240,6 +240,20 @@ def build_argparser() -> argparse.ArgumentParser:
                         "default: leave the env/auto policy alone (auto "
                         "= on iff nproc >= 2 — RESULTS.md 'Host dedup "
                         "A/B')")
+    p.add_argument("--prefetch", default=None,
+                   choices=("auto", "on", "off"),
+                   help="double-buffered upload prefetch for the ddd "
+                        "engines: a background thread reads block k+1's "
+                        "rows + constraint column and stages them onto "
+                        "the device while block k expands, so block "
+                        "boundaries swap to a resident buffer instead of "
+                        "paying drain+read+pad+h2d (utils/prefetch.py; "
+                        "relies on the host stores' disjoint-range "
+                        "append+read contract, utils/native.py) — "
+                        "discovery stays byte-identical, hit or miss. "
+                        "Sets RAFT_TLA_PREFETCH process-wide; default: "
+                        "leave the env/auto policy alone (auto = on iff "
+                        "nproc >= 2 — RESULTS.md 'Upload prefetch A/B')")
     p.add_argument("--lint", default="warn", choices=("warn", "strict"),
                    help="static width-safety pass (analysis/widthcheck) "
                         "before any step build: prove no transition can "
@@ -626,6 +640,11 @@ def main(argv=None) -> int:
         # (utils/keyset.host_dedup_enabled) by the ddd engine families.
         import os
         os.environ["RAFT_TLA_HOSTDEDUP"] = args.host_dedup
+    if args.prefetch is not None:
+        # Same contract: resolved at engine construction
+        # (utils/prefetch.prefetch_enabled) by the ddd engine families.
+        import os
+        os.environ["RAFT_TLA_PREFETCH"] = args.prefetch
     from raft_tla_tpu.serve.sched import enable_compile_cache
     enable_compile_cache(args.compile_cache)
     _DEVICE_ENGINES = ("device", "paged", "streamed", "ddd", "shard",

@@ -78,6 +78,7 @@ from raft_tla_tpu.utils import flushq
 from raft_tla_tpu.utils import keyset
 from raft_tla_tpu.utils import native
 from raft_tla_tpu.utils import pacing
+from raft_tla_tpu.utils import prefetch
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -925,6 +926,11 @@ class DDDEngine:
         # sig-prune/megakernel gates) and deliberately NOT part of
         # _DigestCaps — checkpoints are compatible both directions.
         self._host_dedup = keyset.host_dedup_enabled()
+        # RAFT_TLA_PREFETCH gate: double-buffered background staging of
+        # the next frontier block (utils/prefetch).  Same resolution
+        # discipline; also NOT part of _DigestCaps — checkpoints resume
+        # across either gate setting.
+        self._prefetch = prefetch.prefetch_enabled()
         # Per-flush, per-partition merge budget: 8x the partition's
         # expected share of one flush covers the amortized LSM movement
         # (flush/parts keys in, each moved ~log2(N/flush) ~ 7 times at
@@ -1194,6 +1200,38 @@ class DDDEngine:
                 n_states += self._flush(pend, master, host, constore,
                                         keystore, cov)
         Fcap = self.caps.block
+        # Upload prefetcher (RAFT_TLA_PREFETCH): while the device
+        # expands block k, a daemon thread reads block k+1's rows +
+        # constraint column and stages them into one of two
+        # preallocated buffer sets via device_put, so the block
+        # boundary swaps to a resident buffer instead of paying
+        # drain→read→pad→h2d.  Safe concurrently with the flush
+        # worker: block reads target rows < level_ends[-1], all
+        # published before the level began, while in-flight flushes
+        # append only rows >= level_ends[-1] (the store concurrency
+        # contract, utils/native) — so prefetch-on also drops the
+        # upload's unconditional dedup_wait drain.
+        prefetcher = None
+        if self._prefetch:
+            pf_rows = [np.zeros((Fcap, self.schema.P), np.int32),
+                       np.zeros((Fcap, self.schema.P), np.int32)]
+            pf_con = [np.zeros((Fcap,), bool), np.zeros((Fcap,), bool)]
+
+            def pf_load(start, rows, slot):
+                # range-disjointness precondition (utils/prefetch)
+                assert start + rows <= level_ends[-1], \
+                    (start, rows, level_ends[-1])
+                rb, cb = pf_rows[slot], pf_con[slot]
+                rb[:rows] = host.read(start, rows)
+                cb[:rows] = constore.read(start, rows)[:, 0]
+                if rows < Fcap:          # zero pad == the sync path's
+                    rb[rows:] = 0        # np.zeros concat, byte-exact
+                    cb[rows:] = False
+                return jax.block_until_ready(
+                    (jax.device_put(rb), jax.device_put(cb)))
+
+            prefetcher = prefetch.BlockPrefetcher(pf_load)
+            _cleanup.callback(prefetcher.close)
         viol = None          # (kind, inv_idx, dead_g) once detected
         viol_key = None
         fail = 0
@@ -1224,32 +1262,57 @@ class DDDEngine:
                 level=len(level_ends), n_transitions=n_trans,
                 coverage=dict(aggregate_coverage(self.table, cov)),
                 route_peak=route_peak,
-                flush_backlog=worker.backlog() if worker else None)
+                flush_backlog=worker.backlog() if worker else None,
+                upload_wait_ms=round(prefetcher.wait_s * 1e3, 3)
+                if prefetcher else None,
+                prefetch_hits=prefetcher.hits if prefetcher else None)
 
         n_trans_mark = n_trans   # n_trans as of the current block's start
         while not stopped:
             lvl_lo = level_ends[-2] if len(level_ends) > 1 else 0
             lvl_hi = level_ends[-1]
-            for b_start in range(lvl_lo + blocks_done * Fcap, lvl_hi,
-                                 Fcap):
+            b0 = lvl_lo + blocks_done * Fcap
+            if prefetcher is not None and b0 < lvl_hi:
+                # level start: every block address in [lvl_lo, lvl_hi)
+                # is known now — warm the first block immediately
+                prefetcher.schedule(b0, min(Fcap, lvl_hi - b0))
+            for b_start in range(b0, lvl_hi, Fcap):
                 b_rows = min(Fcap, lvl_hi - b_start)
                 n_trans_mark = n_trans
-                if worker is not None:
-                    # the native stores are not assumed safe for
-                    # concurrent append+read — settle the in-flight
-                    # flush before reading the block
-                    with tel.phases.phase("dedup_wait"):
-                        n_states += worker.drain()
-                with tel.phases.phase("upload") as ph:
-                    blk = host.read(b_start, b_rows)
-                    con = constore.read(b_start, b_rows)[:, 0].astype(bool)
-                    if b_rows < Fcap:
-                        blk = np.concatenate([blk, np.zeros(
-                            (Fcap - b_rows, self.schema.P), np.int32)])
-                        con = np.concatenate(
-                            [con, np.zeros((Fcap - b_rows,), bool)])
-                    fbuf, fcon = ph.sync((jnp.asarray(blk),
-                                          jnp.asarray(con)))
+                if prefetcher is not None:
+                    # prefetch-on: NO pre-upload drain — block reads hit
+                    # rows below lvl_hi only, published before the level
+                    # began; the in-flight flush appends rows >= lvl_hi
+                    # (disjoint ranges, utils/native contract).  The
+                    # dedup_wait phase now fires only at flush_sync /
+                    # checkpoint drains: that asymmetry in the phase
+                    # timers is the gate's signature.
+                    with tel.phases.phase("upload") as ph:
+                        fbuf, fcon = ph.sync(
+                            prefetcher.take(b_start, b_rows))
+                    nxt = b_start + Fcap
+                    if nxt < lvl_hi:
+                        prefetcher.schedule(nxt,
+                                            min(Fcap, lvl_hi - nxt))
+                else:
+                    if worker is not None:
+                        # without the prefetcher's disjointness
+                        # discipline, settle the in-flight flush before
+                        # reading the block
+                        with tel.phases.phase("dedup_wait"):
+                            n_states += worker.drain()
+                    with tel.phases.phase("upload") as ph:
+                        blk = host.read(b_start, b_rows)
+                        con = constore.read(b_start,
+                                            b_rows)[:, 0].astype(bool)
+                        if b_rows < Fcap:
+                            blk = np.concatenate([blk, np.zeros(
+                                (Fcap - b_rows, self.schema.P),
+                                np.int32)])
+                            con = np.concatenate(
+                                [con, np.zeros((Fcap - b_rows,), bool)])
+                        fbuf, fcon = ph.sync((jnp.asarray(blk),
+                                              jnp.asarray(con)))
                 fc = fc._replace(c=jnp.int32(0))
                 # Two-deep segment pipeline: segment k+1 depends on k only
                 # through the filter carry, so it is dispatched BEFORE k's
@@ -1411,6 +1474,11 @@ class DDDEngine:
             if n_states == level_ends[-1]:       # no new states: done
                 break
             level_ends.append(n_states)
+            if prefetcher is not None:
+                # quiesce before any rotation/teardown below; by now the
+                # last take() consumed the final scheduled block, so
+                # this is a no-op unless a stop raced the level end
+                prefetcher.invalidate()
             if frontier:
                 # the just-finished level's rows are dead weight now.
                 # With snapshots, the files outlive the rotation until
@@ -1427,6 +1495,11 @@ class DDDEngine:
                     f"DDD search aborted: {decode_fail(FAIL_LEVEL)} "
                     f"(caps={self.caps}) — grow DDDCapacities and rerun")
 
+        if prefetcher is not None:
+            # stop paths (violation/SIGINT/deadline) can leave a
+            # prefetch in flight; no store read survives past here, so
+            # snapshots, traces and store teardown see a quiet store
+            prefetcher.invalidate()
         flush_sync()
         if not complete and checkpoint and not viol and not fail:
             # graceful stop (SIGINT or deadline): same mid-level snapshot

@@ -25,7 +25,7 @@ wall).  This module replaces all of that with:
   ``level_end`` derived automatically from level transitions and
   ``violation`` derived from the final :class:`~raft_tla_tpu.engine.EngineResult`.
 
-Event grammar (``SCHEMA_VERSION`` = 5; earlier-version lines remain
+Event grammar (``SCHEMA_VERSION`` = 6; earlier-version lines remain
 valid) —
 every line is one JSON object with base fields ``v`` (schema version),
 ``event`` (type) and ``ts`` (unix epoch seconds):
@@ -80,11 +80,24 @@ optional, invalid on a ``"v" < 5`` line:
                            was observed (0/1 — the worker is depth-1
                            ordered; absent = synchronous host dedup)
 
+Version 6 adds the ddd upload-prefetch attribution fields — both
+optional, both invalid on a ``"v" < 6`` line:
+
+``segment.upload_wait_ms`` cumulative main-thread wall spent waiting in
+                           the upload phase for a staged block (hits)
+                           or loading one inline (misses); absent =
+                           prefetch gate off
+``segment.prefetch_hits``  block uploads served from an already-staged
+                           buffer since the run started (misses =
+                           blocks - hits; the in-engine warm rate
+                           runs/prefetch_ab.py reports)
+
 A run log with no ``run_end`` means the process died — crash attribution
 for free.  The schema is strict: unknown fields fail validation and the
-v2-only event types (resp. v3/v4/v5-only fields) are invalid on a
-``"v": 1`` (resp. ``"v" < 3`` / ``"v" < 4`` / ``"v" < 5``) line, so any
-addition requires a version bump (versioning policy in README.md).
+v2-only event types (resp. v3/v4/v5/v6-only fields) are invalid on a
+``"v": 1`` (resp. ``"v" < 3`` / ``"v" < 4`` / ``"v" < 5`` / ``"v" < 6``)
+line, so any addition requires a version bump (versioning policy in
+README.md).
 """
 
 from __future__ import annotations
@@ -97,8 +110,8 @@ import subprocess
 import threading
 import time
 
-SCHEMA_VERSION = 5
-_VERSIONS = (1, 2, 3, 4, 5)  # versions validate_event accepts
+SCHEMA_VERSION = 6
+_VERSIONS = (1, 2, 3, 4, 5, 6)  # versions validate_event accepts
 
 # Environment knobs (set by check.py --events/--phase-timers; inherited by
 # liveness re-runs and bench children the same way RAFT_TLA_SIGPRUNE is).
@@ -171,6 +184,10 @@ _V4_FIELDS = {"segment": frozenset({"bin", "inflight"})}
 # host-dedup attribution) — invalid on a "v" < 5 line.
 _V5_FIELDS = {"segment": frozenset({"flush_backlog"})}
 
+# Fields that only exist from schema version 6 on (ddd upload-prefetch
+# attribution) — invalid on a "v" < 6 line.
+_V6_FIELDS = {"segment": frozenset({"upload_wait_ms", "prefetch_hits"})}
+
 _OPTIONAL = {
     "run_start": {"bounds": dict, "symmetry": list, "view": str,
                   "chunk": int, "caps": str, "n_states": int,
@@ -178,7 +195,8 @@ _OPTIONAL = {
                   "pid": int},
     "segment": {"coverage": dict, "route_peak": int, "n_devices": int,
                 "inv_evals": dict, "phase_s": dict, "device_rates": list,
-                "bin": str, "inflight": int, "flush_backlog": int},
+                "bin": str, "inflight": int, "flush_backlog": int,
+                "upload_wait_ms": _NUM, "prefetch_hits": int},
     "level_end": {},
     "checkpoint": {"n_states": int},
     "violation": {"kind": str},
@@ -226,6 +244,7 @@ def validate_event(d: dict) -> list:
     v3_only = _V3_FIELDS.get(ev, frozenset())
     v4_only = _V4_FIELDS.get(ev, frozenset())
     v5_only = _V5_FIELDS.get(ev, frozenset())
+    v6_only = _V6_FIELDS.get(ev, frozenset())
     for k, val in d.items():
         if k in _BASE or k in req:
             continue
@@ -240,6 +259,8 @@ def validate_event(d: dict) -> list:
             errs.append(f"{ev}: field {k!r} requires schema version >= 4")
         elif k in v5_only and d["v"] in _VERSIONS and d["v"] < 5:
             errs.append(f"{ev}: field {k!r} requires schema version >= 5")
+        elif k in v6_only and d["v"] in _VERSIONS and d["v"] < 6:
+            errs.append(f"{ev}: field {k!r} requires schema version >= 6")
     return errs
 
 
@@ -279,6 +300,8 @@ class ProgressRecord:
     bin: str | None = None            # serve: step-signature bin tag
     inflight: int | None = None       # serve: dispatches in flight
     flush_backlog: int | None = None  # ddd: background flushes pending
+    upload_wait_ms: float | None = None  # ddd: cumulative upload wait
+    prefetch_hits: int | None = None  # ddd: staged-buffer block uploads
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -324,7 +347,9 @@ class ProgressTracker:
                device_rates: list | None = None,
                bin: str | None = None,
                inflight: int | None = None,
-               flush_backlog: int | None = None) -> ProgressRecord:
+               flush_backlog: int | None = None,
+               upload_wait_ms: float | None = None,
+               prefetch_hits: int | None = None) -> ProgressRecord:
         wall = time.monotonic() - self.t0
         reported = n_states if n_incl is None else max(n_states, n_incl)
         if self._prev_n is None:  # unknown baseline: anchor, rate 0
@@ -357,6 +382,8 @@ class ProgressTracker:
             bin=bin,
             inflight=inflight,
             flush_backlog=flush_backlog,
+            upload_wait_ms=upload_wait_ms,
+            prefetch_hits=prefetch_hits,
         )
 
 
@@ -549,14 +576,18 @@ class RunTelemetry:
                 device_rates: list | None = None,
                 bin: str | None = None,
                 inflight: int | None = None,
-                flush_backlog: int | None = None) -> ProgressRecord:
+                flush_backlog: int | None = None,
+                upload_wait_ms: float | None = None,
+                prefetch_hits: int | None = None) -> ProgressRecord:
         rec = self.tracker.record(
             n_states, level, n_transitions, coverage=coverage,
             route_peak=route_peak, n_incl=n_incl,
             phase_s=self.phases.snapshot(),
             device_rates=device_rates,
             bin=bin, inflight=inflight,
-            flush_backlog=flush_backlog)
+            flush_backlog=flush_backlog,
+            upload_wait_ms=upload_wait_ms,
+            prefetch_hits=prefetch_hits)
         if self.log is not None:
             if self._last_level is not None and level > self._last_level:
                 # The boundary count is the count as observed at the first

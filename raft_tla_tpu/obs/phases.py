@@ -29,7 +29,14 @@ the depth-1 worker — blocks only while the *previous* flush is still
 running, so a nonzero wall here means the device outran the host dedup)
 and ``dedup_wait`` (drain at a block/checkpoint/level/stop boundary —
 the part of the flush that did NOT overlap device compute), so the
-overlap is attributable, not inferred.
+overlap is attributable, not inferred.  With upload prefetch
+(``RAFT_TLA_PREFETCH``) the per-block ``dedup_wait`` drain disappears
+entirely — block reads rely on the stores' disjoint-range concurrency
+contract instead — so ``dedup_wait`` fires only at
+checkpoint/level/stop drains (the on/off asymmetry is the gate's
+phase-timer signature), and ``upload`` becomes the wait for an
+already-staged buffer (a prefetch *hit* costs a swap; a *miss* pays
+the old read+pad+h2d inline).
 
 This module is host-path orchestration only — nothing here is ever
 traced (the no-op handle is what jit-adjacent code touches).

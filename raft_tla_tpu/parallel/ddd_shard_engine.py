@@ -91,6 +91,7 @@ from raft_tla_tpu.utils import ckpt
 from raft_tla_tpu.utils import keyset
 from raft_tla_tpu.utils import native
 from raft_tla_tpu.utils import pacing
+from raft_tla_tpu.utils import prefetch
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -450,6 +451,13 @@ class DDDShardEngine:
         # synchronous here: the canonical (level, window, shard) drain
         # order is fixed at window boundaries, not flush time.
         self._host_dedup = keyset.host_dedup_enabled()
+        # RAFT_TLA_PREFETCH: the next window's rows are read and staged
+        # by a daemon thread while the devices expand the current one
+        # (utils/prefetch).  Flushes stay synchronous and the canonical
+        # (level, window, shard) drain order is untouched — the prefetch
+        # only reads rows published before the level began, disjoint
+        # from anything the window-boundary drain appends.
+        self._prefetch = prefetch.prefetch_enabled()
         self._merge_budget = max(1 << 16,
                                  (8 * self.caps.flush)
                                  // keyset.DEFAULT_PARTS)
@@ -473,7 +481,9 @@ class DDDShardEngine:
             donate_argnums=(0, 1))
         self._in_shardings = [
             NamedSharding(self.mesh, dp) for _ in range(4)]
-        self._gbuf = self._gcon = None    # window staging, lazy-alloc
+        # window staging, lazy-alloc: one buffer set per prefetch slot
+        # (slot 0 doubles as the gate-off synchronous path's buffers)
+        self._gstage: list = [None, None]
 
     # -- device-side helpers --------------------------------------------
 
@@ -501,37 +511,43 @@ class DDDShardEngine:
             olane=z((nd * OCAP,), np.int32),
             ocon=z((nd * OCAP,), bool))
 
-    def _upload_window(self, host, constore, wbase: int, wrows: int):
+    def _upload_window(self, host, constore, wbase: int, wrows: int,
+                       slot: int = 0):
         """Sharded upload of one frontier window: shard s expands global
         rows [wbase + s*block, ...); parent ids ride along.  The host
-        staging buffers are allocated once (inter-window critical path:
-        devices idle during upload) and only their live prefix is
-        rewritten — rows past ``wrows`` are masked off by ``nrows``, so
-        stale tail contents are never read."""
+        staging buffers are allocated once per slot (inter-window
+        critical path: devices idle during upload) and only their live
+        prefix is rewritten — rows past ``wrows`` are masked off by
+        ``nrows``, so stale tail contents are never read.  ``slot``
+        selects the staging buffer set: the upload prefetcher
+        double-buffers so staging window k+1 never scribbles over the
+        buffers window k was uploaded from."""
         nd, Fcap = self.ndev, self.caps.block
-        if self._gbuf is None:
-            self._gbuf = np.zeros((nd * Fcap, self.schema.P), np.int32)
-            self._gcon = np.zeros((nd * Fcap,), bool)
+        if self._gstage[slot] is None:
+            self._gstage[slot] = (
+                np.zeros((nd * Fcap, self.schema.P), np.int32),
+                np.zeros((nd * Fcap,), bool))
+        gbuf, gcon = self._gstage[slot]
         if self.caps.cp:
             # CP mode: every shard expands the SAME rows (its lane slice)
             blk = host.read(wbase, wrows)
             con = constore.read(wbase, wrows)[:, 0]
             for s in range(nd):
-                self._gbuf[s * Fcap:s * Fcap + wrows] = blk
-                self._gcon[s * Fcap:s * Fcap + wrows] = con
+                gbuf[s * Fcap:s * Fcap + wrows] = blk
+                gcon[s * Fcap:s * Fcap + wrows] = con
             # WINDOW-RELATIVE parent ids (fit int32 at any campaign
             # depth); the harvest rebases by adding wbase as int64
             gpar = np.tile(np.arange(Fcap), nd).astype(np.int32)
             nrows = np.full((nd,), wrows, np.int32)
         else:
-            self._gbuf[:wrows] = host.read(wbase, wrows)
-            self._gcon[:wrows] = constore.read(wbase, wrows)[:, 0]
+            gbuf[:wrows] = host.read(wbase, wrows)
+            gcon[:wrows] = constore.read(wbase, wrows)[:, 0]
             gpar = np.arange(nd * Fcap, dtype=np.int32)  # window-relative
             nrows = np.clip(wrows - np.arange(nd) * Fcap, 0, Fcap) \
                 .astype(np.int32)
         sh = self._in_shardings
-        return (jax.device_put(self._gbuf, sh[0]),
-                jax.device_put(self._gcon, sh[1]),
+        return (jax.device_put(gbuf, sh[0]),
+                jax.device_put(gcon, sh[1]),
                 jax.device_put(gpar, sh[2]), jax.device_put(nrows, sh[3]),
                 int(nrows.max() + self.config.chunk - 1)
                 // self.config.chunk)
@@ -759,6 +775,25 @@ class DDDShardEngine:
         # global window rows: row-sharded in DP mode, replicated in CP
         W = self.caps.block if self.caps.cp \
             else self.ndev * self.caps.block
+        # Upload prefetcher (RAFT_TLA_PREFETCH): stage window k+1 on a
+        # daemon thread while the devices expand window k.  Reads hit
+        # rows < level_ends[-1] only — disjoint from everything the
+        # window-boundary drain appends (>= level_ends[-1]), the store
+        # concurrency contract (utils/native) — and the canonical
+        # (level, window, shard) drain order is untouched.
+        prefetcher = None
+        if self._prefetch:
+            def pf_load(wb, wr, slot):
+                # range-disjointness precondition (utils/prefetch)
+                assert wb + wr <= level_ends[-1], \
+                    (wb, wr, level_ends[-1])
+                out = self._upload_window(host, constore, wb, wr,
+                                          slot=slot)
+                jax.block_until_ready(out[:4])
+                return out
+
+            prefetcher = prefetch.BlockPrefetcher(pf_load)
+            _cleanup.callback(prefetcher.close)
         OCAP = self.caps.seg_rows
         fail = 0
         viol = None        # (kind, inv_idx, key_or_gid) once detected
@@ -787,17 +822,36 @@ class DDDShardEngine:
             tel.segment(
                 n_states=n_states, n_incl=n_incl,
                 level=len(level_ends), n_transitions=n_trans,
-                coverage=dict(aggregate_coverage(self.table, cov)))
+                coverage=dict(aggregate_coverage(self.table, cov)),
+                upload_wait_ms=round(prefetcher.wait_s * 1e3, 3)
+                if prefetcher else None,
+                prefetch_hits=prefetcher.hits if prefetcher else None)
 
         while not stopped:
             lvl_lo = level_ends[-2] if len(level_ends) > 1 else 0
             lvl_hi = level_ends[-1]
-            for wbase in range(lvl_lo + blocks_done * W, lvl_hi, W):
+            w0 = lvl_lo + blocks_done * W
+            if prefetcher is not None and w0 < lvl_hi:
+                # level start: all window addresses are known — warm the
+                # first window immediately
+                prefetcher.schedule(w0, min(W, lvl_hi - w0))
+            for wbase in range(w0, lvl_hi, W):
                 wrows = min(W, lvl_hi - wbase)
-                with tel.phases.phase("upload") as ph:
-                    fbuf, fcon, fpar, nrows, n_chunks = \
-                        self._upload_window(host, constore, wbase, wrows)
-                    ph.sync((fbuf, fcon, fpar))
+                if prefetcher is not None:
+                    # hit: swap to the staged, already-resident window;
+                    # miss: the loader runs inline, same bytes either way
+                    with tel.phases.phase("upload"):
+                        fbuf, fcon, fpar, nrows, n_chunks = \
+                            prefetcher.take(wbase, wrows)
+                    nxtw = wbase + W
+                    if nxtw < lvl_hi:
+                        prefetcher.schedule(nxtw, min(W, lvl_hi - nxtw))
+                else:
+                    with tel.phases.phase("upload") as ph:
+                        fbuf, fcon, fpar, nrows, n_chunks = \
+                            self._upload_window(host, constore, wbase,
+                                                wrows)
+                        ph.sync((fbuf, fcon, fpar))
                 fc = fc._replace(c=jnp.int32(0))
                 # Two-deep segment pipeline (the ddd_engine PP overlap):
                 # segment k+1 depends on k only through the filter carry,
@@ -955,6 +1009,10 @@ class DDDShardEngine:
             if n_states == level_ends[-1]:       # no new states: done
                 break
             level_ends.append(n_states)
+            if prefetcher is not None:
+                # quiesce before rotation (no-op unless a stop raced the
+                # level end — the last take() consumed the final window)
+                prefetcher.invalidate()
             if self.caps.retention == "frontier":
                 # finished level's rows are dead weight (snapshots keep
                 # files alive until their npz commits; tmpdir runs have
@@ -969,6 +1027,11 @@ class DDDShardEngine:
                     f"DDD-shard search aborted: {decode_fail(FAIL_LEVEL)} "
                     f"(caps={self.caps}) — grow capacities and rerun")
 
+        if prefetcher is not None:
+            # stop paths can leave a window prefetch in flight; no store
+            # read survives past here, so the drain, traces and store
+            # teardown below see a quiet store
+            prefetcher.invalidate()
         # terminal drain (stopped runs keep everything streamed so far —
         # the relaxed chunk-granular stop, as shard_engine)
         with tel.phases.phase("dedup"):

@@ -13,11 +13,14 @@ flush i's new keys are in the master tiers before flush i+1's dedup
 begins, exactly as in the synchronous engine.
 
 The engine's drain discipline (ddd_engine.py): every reader of state the
-flush mutates — block upload (the native stores are not assumed safe for
-concurrent append+read), checkpoint save, level boundaries, `_IDX_CEIL`
-checks, violation identity, lossless SIGINT/deadline stops — calls
-`drain()` first, so all byte-identity and lossless-stop arguments reduce
-to the synchronous case.
+flush mutates — checkpoint save, level boundaries, `_IDX_CEIL` checks,
+violation identity, lossless SIGINT/deadline stops — calls `drain()`
+first, so all byte-identity and lossless-stop arguments reduce to the
+synchronous case.  The block upload drains too when the prefetch gate is
+off; with ``RAFT_TLA_PREFETCH`` on it instead relies on the stores'
+one-appender + disjoint-range-reader contract (utils/native,
+utils/prefetch) — uploads read only rows published before the level
+began, while an in-flight flush appends strictly past them.
 
 Worker exceptions are captured and re-raised on the main thread at the
 next `submit`/`collect`/`drain`, so a flush failure cannot be silently

@@ -110,6 +110,13 @@ class HostStore:
 
     The TLC ``states/`` analog (SURVEY §2.8): discovery-indexed, append-only,
     host-RAM resident.  C++-backed when the toolchain is available.
+
+    Safe for ONE appender thread plus concurrent readers of disjoint,
+    already-published ranges: the C++ side publishes new rows through an
+    atomic block directory and a release-stored size, so any read that
+    bounds-checks against a previously observed ``len()`` sees fully
+    written rows (the upload-prefetch contract, ``utils/prefetch``).
+    Reads racing the rows being appended remain undefined.
     """
 
     def __init__(self, width: int):
@@ -180,32 +187,45 @@ class HostStore:
 
 class _BlockList:
     """Appended ndarray blocks with O(log blocks) range reads (no global
-    concatenation — the C++ twin's block structure, in NumPy)."""
+    concatenation — the C++ twin's block structure, in NumPy).
+
+    Concurrency contract (mirrors the C++ store): one appender thread
+    plus readers of already-published rows.  ``append`` publishes the
+    block before the new cumulative count, and readers snapshot both
+    references once (GIL-atomic) before indexing, so a read below a
+    previously observed ``len()`` always sees fully-appended blocks.
+    """
 
     def __init__(self):
         self._blocks: list = []
         self._ends = np.zeros((0,), np.int64)   # cumulative row counts
 
     def __len__(self) -> int:
-        return int(self._ends[-1]) if self._blocks else 0
+        ends = self._ends
+        return int(ends[-1]) if ends.shape[0] else 0
 
     def append(self, block: np.ndarray) -> None:
         total = len(self) + block.shape[0]
+        # block first, THEN the count that publishes it (the reader's
+        # snapshot of _ends never indexes past its snapshot of _blocks)
         self._blocks.append(block)
         self._ends = np.append(self._ends, total)
 
     def read(self, start: int, n: int) -> np.ndarray:
+        blocks, ends = self._blocks, self._ends   # one coherent snapshot
+        total = int(ends[-1]) if ends.shape[0] else 0
+        if not (0 <= start and start + n <= total):
+            raise IndexError(f"read [{start}, {start + n}) of {total}")
         if n <= 0:
-            return self._blocks[0][:0] if self._blocks \
-                else np.empty((0,), np.int32)
+            return blocks[0][:0] if blocks else np.empty((0,), np.int32)
         out = []
-        b = int(np.searchsorted(self._ends, start, side="right"))
+        b = int(np.searchsorted(ends, start, side="right"))
         pos = start
         while n > 0:
-            b_start = int(self._ends[b - 1]) if b else 0
-            take = min(n, int(self._ends[b]) - pos)
+            b_start = int(ends[b - 1]) if b else 0
+            take = min(n, int(ends[b]) - pos)
             off = pos - b_start
-            out.append(self._blocks[b][off:off + take])
+            out.append(blocks[b][off:off + take])
             pos += take
             n -= take
             b += 1
@@ -213,7 +233,9 @@ class _BlockList:
 
 
 class PyHostStore:
-    """NumPy fallback with the identical interface."""
+    """NumPy fallback with the identical interface — including the
+    one-appender + disjoint-range-readers concurrency contract and the
+    ``IndexError`` bounds messages of the C++ store."""
 
     def __init__(self, width: int):
         self.width = int(width)
@@ -239,9 +261,17 @@ class PyHostStore:
         return len(self._parents)
 
     def read_links(self, start: int, n: int):
+        n_links = len(self._parents)
+        if not (0 <= start and start + n <= n_links):
+            raise IndexError(
+                f"read_links [{start}, {start + n}) of {n_links}")
         return self._parents.read(start, n), self._lanes.read(start, n)
 
     def trace_chain(self, from_row: int) -> np.ndarray:
+        n_links = len(self._parents)
+        if not (0 <= from_row < n_links):
+            raise IndexError(
+                f"trace_chain from {from_row} of {n_links}")
         chain = []
         cur = int(from_row)
         while cur >= 0:
@@ -272,6 +302,10 @@ class FileStore:
     entirely).  The header's row count is committed by :meth:`sync` —
     torn appends past the last sync are discarded on reopen, the same
     crash contract as ckpt.stream_rows_append.
+
+    Reads are positionless (``os.preadv``), so one appender thread plus
+    concurrent readers of rows below a previously observed ``len()`` is
+    safe — the host-store concurrency contract, see :class:`HostStore`.
     """
 
     def __init__(self, path: str, width: int, base: int = 0,
@@ -290,8 +324,14 @@ class FileStore:
                 raise ValueError(
                     f"{path}: not a width-{self.width} row stream")
             self._n = int(hdr[0])
-            # drop any torn tail beyond the committed header count
-            self._f.truncate(16 + self._n * self.width * 4)
+            # drop any torn tail beyond the committed header count —
+            # but never extend: truncate() also GROWS a file with a
+            # zero hole, and a stream shorter than its header is
+            # corruption read() must surface, not silently zero-fill
+            end = 16 + self._n * self.width * 4
+            self._f.seek(0, os.SEEK_END)
+            if self._f.tell() > end:
+                self._f.truncate(end)
 
     def _write_header(self) -> None:
         self._f.seek(0)
@@ -313,9 +353,29 @@ class FileStore:
             raise IndexError(
                 f"read [{start}, {start + n}) of [{self.base}, "
                 f"{len(self)})")
-        self._f.seek(16 + (start - self.base) * self.width * 4)
-        out = np.fromfile(self._f, np.int32, n * self.width)
-        return out.reshape(n, self.width)
+        out = np.empty((n, self.width), np.int32)
+        if n == 0:
+            return out
+        # Positionless pread into the preallocated buffer: no shared
+        # fd-offset, so a prefetch-thread read never races the appender's
+        # seek+tofile or a header rewrite in sync() (appends land via
+        # numpy's fd dup, already page-cache-visible here).  One appender
+        # plus readers of rows below an observed len() is safe; reads of
+        # the appending tail are not.
+        nbytes = n * self.width * 4
+        mv = memoryview(out).cast("B")
+        fd, off, got = self._f.fileno(), 16 + (start - self.base) \
+            * self.width * 4, 0
+        while got < nbytes:
+            k = os.preadv(fd, [mv[got:]], off + got)
+            if k <= 0:
+                break
+            got += k
+        if got != nbytes:
+            raise ValueError(
+                f"{self.path}: truncated row stream — expected {n} rows "
+                f"at index {start}, got {got // (self.width * 4)}")
+        return out
 
     def sync(self) -> None:
         """Commit appended rows: data flush, then header, then fsync."""

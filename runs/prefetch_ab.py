@@ -1,0 +1,287 @@
+"""A/B of the double-buffered upload prefetch (utils/prefetch.py,
+RAFT_TLA_PREFETCH) — decides the prefetch_enabled auto policy.
+Protocol per the sig-prune/megakernel/hostdedup rounds: chip-state
+fiducials via ``bench.py --fiducial`` bracketing the session (now
+including the pinned ``store_read_mb_s`` host probe), interleaved reps,
+medians, per-rep byte-parity asserts.  Two gates:
+
+(a) **single-thread-measurable — the block-boundary spike.**  A
+    host+device microbench of the upload chain itself: per block
+    boundary, the sync arm pays read rows + read constraint column +
+    pad + ``device_put`` + ready inline, while the prefetch arm pays
+    only ``take()`` (the chain ran behind the previous block's device
+    work, and the h2d dispatch was already issued).  The headline
+    regime is **frontier/disk** (`FileStore` — the external-memory
+    mode where the read is a real disk read); the RAM regime
+    (`HostStore`) is recorded alongside.  Statistic: worst and median
+    block-boundary wall per arm, median across reps; PASS = prefetch
+    worst boundary <= 0.8x sync worst in the disk regime.  Every taken
+    buffer is asserted byte-equal to the sync arm's read, every block,
+    every rep.
+
+(b) **overlap — in-engine throughput.**  The flagship-shape DDD probe
+    (chunk 4096, deadline per arm) with RAFT_TLA_PREFETCH off vs on,
+    in BOTH retention modes; segment-stream n_states parity asserted
+    on the common prefix; warm states/s excludes the compile segment;
+    the on arm also reports the schema-v6 ``prefetch_hits`` /
+    ``upload_wait_ms`` observability fields.  PASS = >= 1.10x warm
+    states/s with nproc >= 2.  On an nproc=1 host the prefetch thread
+    and the harvest loop time-slice one core, so the thread-overlap
+    half is expected to REFUTE here (the hostdedup round measured the
+    same shape honestly) — recorded as such, with the on-chip re-A/B
+    queued alongside ROADMAP item 2's jobs.
+
+Usage: python runs/prefetch_ab.py [--cpu] [reps]
+Artifact: runs/prefetch_ab.out (RESULTS.md "Upload prefetch A/B").
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+if "--cpu" in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.utils import native
+from raft_tla_tpu.utils.prefetch import BlockPrefetcher
+
+_ints = [int(a) for a in sys.argv[1:] if a.isdigit()]
+REPS = _ints[0] if _ints else 3
+DEADLINE_S = 60.0                  # per in-engine arm
+
+# gate (a) shape: 32 block boundaries of 2^16 rows x 64 lanes (the
+# flagship state width class) + a width-1 constraint column — big
+# enough that the read+pad+h2d chain is milliseconds, small enough to
+# cycle many boundaries per rep
+BROWS, NBLOCKS, P = 1 << 16, 32, 64
+
+
+def _fiducial():
+    """bench.py --fiducial in a child (fresh jit caches, pinned gates)."""
+    bench = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    try:
+        out = subprocess.run(
+            [sys.executable, bench, "--fiducial"], capture_output=True,
+            text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS":
+                 jax.default_backend()}).stdout
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception as e:                       # fiducial is evidence,
+        return {"fiducial_error": repr(e)}       # not a gate — record
+
+
+results = {"platform": jax.devices()[0].platform, "reps": REPS,
+           "nproc": os.cpu_count() or 1,
+           "spike": {"block_rows": BROWS, "n_blocks": NBLOCKS,
+                     "width": P},
+           "inengine": {}}
+results["fiducial_start"] = _fiducial()
+print("fiducial_start:", json.dumps(results["fiducial_start"]),
+      flush=True)
+
+# -- gate (a): block-boundary upload-wall spikes ---------------------------
+# Per regime (disk = FileStore = frontier retention's store; ram =
+# HostStore = full retention's), one fixed pseudorandom level per rep;
+# both arms walk the same blocks with the same simulated device work
+# between boundaries (a jitted matmul chain, ~the expand+fingerprint
+# wall of a block), so the only difference is WHERE the upload chain
+# runs.  Per-boundary walls; statistic worst/median per arm, median
+# across reps.
+_mm = jax.jit(lambda x: jnp.tanh(x @ x))
+_mx = jnp.asarray(np.random.default_rng(0)
+                  .standard_normal((768, 768), np.float32))
+_mm(_mx).block_until_ready()                   # compile outside timing
+
+
+def _device_work():
+    y = _mx
+    for _ in range(4):
+        y = _mm(y)
+    y.block_until_ready()
+
+
+def _mk_stores(regime, tmp, rows, con):
+    if regime == "disk":
+        st = native.FileStore(os.path.join(tmp, "rows.bin"), width=P,
+                              reset=True)
+        cs = native.FileStore(os.path.join(tmp, "con.bin"), width=1,
+                              reset=True)
+    else:
+        st, cs = native.HostStore(P), native.HostStore(1)
+    st.append(rows)
+    cs.append(con)
+    if regime == "disk":
+        st.sync()
+        cs.sync()
+    return st, cs
+
+
+spike_stats = {"disk": {"sync": [], "prefetch": []},
+               "ram": {"sync": [], "prefetch": []}}
+for regime in ("disk", "ram"):
+    for rep in range(REPS):
+        rng = np.random.default_rng(100 + rep)
+        rows = rng.integers(-1000, 1000, size=(BROWS * NBLOCKS, P),
+                            dtype=np.int32)
+        con = rng.integers(0, 2, size=(BROWS * NBLOCKS, 1),
+                           dtype=np.int32)
+        with tempfile.TemporaryDirectory() as tmp:
+            st, cs = _mk_stores(regime, tmp, rows, con)
+            # sync arm: the old upload chain at every boundary
+            walls_sync, sync_reads = [], []
+            for b in range(NBLOCKS):
+                _device_work()
+                t0 = time.monotonic()
+                rb = st.read(b * BROWS, BROWS)
+                cb = cs.read(b * BROWS, BROWS)[:, 0].astype(bool)
+                fb, fc = jax.device_put(rb), jax.device_put(cb)
+                jax.block_until_ready((fb, fc))
+                walls_sync.append(time.monotonic() - t0)
+                sync_reads.append((rb, cb))
+            # prefetch arm: engine-shaped loop — take, then schedule
+            # the next block behind this block's device work
+            pf_rows = [np.zeros((BROWS, P), np.int32) for _ in range(2)]
+            pf_con = [np.zeros((BROWS,), bool) for _ in range(2)]
+
+            def pf_load(start, n, slot, _st=st, _cs=cs):
+                rb, cb = pf_rows[slot], pf_con[slot]
+                rb[:n] = _st.read(start, n)
+                cb[:n] = _cs.read(start, n)[:, 0]
+                return jax.block_until_ready(
+                    (jax.device_put(rb), jax.device_put(cb)))
+
+            pf = BlockPrefetcher(pf_load)
+            walls_pf = []
+            try:
+                pf.schedule(0, BROWS)
+                for b in range(NBLOCKS):
+                    _device_work()
+                    t0 = time.monotonic()
+                    fb, fc = pf.take(b * BROWS, BROWS)
+                    walls_pf.append(time.monotonic() - t0)
+                    if b + 1 < NBLOCKS:
+                        pf.schedule((b + 1) * BROWS, BROWS)
+                    # per-boundary byte parity vs the sync arm's read
+                    rb, cb = sync_reads[b]
+                    assert np.array_equal(np.asarray(fb), rb), \
+                        "prefetch row-buffer parity failed"
+                    assert np.array_equal(np.asarray(fc), cb), \
+                        "prefetch constraint-buffer parity failed"
+                hits = pf.hits
+            finally:
+                pf.close()
+            st.close()
+            cs.close()
+        for arm, walls in (("sync", walls_sync), ("prefetch", walls_pf)):
+            w = sorted(walls)
+            spike_stats[regime][arm].append((w[len(w) // 2], w[-1]))
+        print(f"{regime:4} rep {rep}: sync med "
+              f"{spike_stats[regime]['sync'][-1][0] * 1e3:7.2f} ms "
+              f"worst {spike_stats[regime]['sync'][-1][1] * 1e3:8.2f} ms"
+              f"   prefetch med "
+              f"{spike_stats[regime]['prefetch'][-1][0] * 1e3:7.2f} ms "
+              f"worst "
+              f"{spike_stats[regime]['prefetch'][-1][1] * 1e3:8.2f} ms "
+              f"(hits {hits}/{NBLOCKS})", flush=True)
+
+for regime in ("disk", "ram"):
+    for arm in ("sync", "prefetch"):
+        meds = sorted(m for m, _w in spike_stats[regime][arm])
+        worsts = sorted(w for _m, w in spike_stats[regime][arm])
+        spike_stats[regime][arm] = {
+            "median_boundary_ms": round(meds[len(meds) // 2] * 1e3, 2),
+            "worst_boundary_ms": round(worsts[len(worsts) // 2] * 1e3, 2)}
+    results["spike"][regime] = spike_stats[regime]
+disk_ratio = (results["spike"]["disk"]["prefetch"]["worst_boundary_ms"]
+              / max(results["spike"]["disk"]["sync"]["worst_boundary_ms"],
+                    1e-9))
+results["spike"]["disk_prefetch_vs_sync_worst"] = round(disk_ratio, 3)
+results["spike"]["gate_a_pass"] = disk_ratio <= 0.8
+print(f"gate (a): disk worst boundary prefetch/sync {disk_ratio:.3f}x "
+      f"-> {'PASS' if results['spike']['gate_a_pass'] else 'FAIL'}",
+      flush=True)
+
+# -- gate (b): in-engine overlap (states/s off vs on, both retentions) -----
+from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+
+cfg = CheckConfig(bounds=Bounds(n_servers=3, n_values=2, max_term=2,
+                                max_log=1, max_msgs=2, max_dup=1),
+                  spec="full",
+                  invariants=("NoTwoLeaders", "LogMatching",
+                              "CommittedWithinLog", "LeaderCompleteness"),
+                  symmetry=("Server",), chunk=4096)
+for retention in ("full", "frontier"):
+    caps = DDDCapacities(block=1 << 18, table=1 << 22, flush=1 << 22,
+                         levels=128, retention=retention)
+    streams = {}
+    results["inengine"][retention] = {}
+    for mode in ("off", "on"):
+        os.environ["RAFT_TLA_PREFETCH"] = mode
+        stats: list = []
+        t0 = time.monotonic()
+        try:
+            r = DDDEngine(cfg, caps).check(deadline_s=DEADLINE_S,
+                                           on_progress=stats.append)
+        finally:
+            os.environ.pop("RAFT_TLA_PREFETCH", None)
+        wall = time.monotonic() - t0
+        streams[mode] = [s["n_states"] for s in stats]
+        if len(stats) >= 2:          # warm rate, compile segment excluded
+            d_states = stats[-1]["n_states"] - stats[0]["n_states"]
+            d_wall = stats[-1]["wall_s"] - stats[0]["wall_s"]
+        else:
+            d_states, d_wall = r.n_states, wall
+        rec = {"wall_s": round(wall, 2), "states": r.n_states,
+               "level": stats[-1]["level"] if stats else 0,
+               "states_per_sec": round(d_states / max(d_wall, 1e-9), 1),
+               "segments": len(stats)}
+        if mode == "on" and stats:
+            rec["prefetch_hits"] = stats[-1].get("prefetch_hits")
+            rec["upload_wait_ms"] = stats[-1].get("upload_wait_ms")
+        results["inengine"][retention][mode] = rec
+        print(f"inengine {retention:8} {mode:3}  {wall:7.2f} s  "
+              f"{r.n_states} states to level {rec['level']}  "
+              f"warm {rec['states_per_sec']:.0f}/s"
+              + (f"  hits {rec.get('prefetch_hits')}"
+                 f" wait {rec.get('upload_wait_ms')} ms"
+                 if mode == "on" else ""), flush=True)
+    n_common = min(len(streams["off"]), len(streams["on"]))
+    assert n_common > 0, "an arm produced no segments"
+    assert streams["off"][:n_common] == streams["on"][:n_common], \
+        f"segment n_states parity failed ({retention})"
+    results["inengine"][retention]["parity_segments"] = n_common
+    ratio = round(
+        results["inengine"][retention]["on"]["states_per_sec"]
+        / max(results["inengine"][retention]["off"]["states_per_sec"],
+              1e-9), 3)
+    results["inengine"][retention]["on_vs_off_warm_rate"] = ratio
+multi = (os.cpu_count() or 1) >= 2
+worst_ratio = min(results["inengine"][r]["on_vs_off_warm_rate"]
+                  for r in ("full", "frontier"))
+results["inengine"]["gate_b_applicable"] = multi
+results["inengine"]["gate_b_pass"] = bool(multi and worst_ratio >= 1.10)
+print(f"gate (b): on/off warm rate full "
+      f"{results['inengine']['full']['on_vs_off_warm_rate']:.3f}x / "
+      f"frontier "
+      f"{results['inengine']['frontier']['on_vs_off_warm_rate']:.3f}x, "
+      f"nproc {os.cpu_count() or 1} -> "
+      + ("PASS" if results["inengine"]["gate_b_pass"] else
+         ("FAIL" if multi else
+          "REFUTED on this host (nproc=1 — the prefetch thread and the "
+          "harvest loop time-slice one core; on-chip re-A/B queued)")),
+      flush=True)
+
+results["fiducial_end"] = _fiducial()
+print("fiducial_end:", json.dumps(results["fiducial_end"]), flush=True)
+print(json.dumps(results))

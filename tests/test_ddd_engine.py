@@ -65,9 +65,12 @@ def test_capacity_past_device_table_scale():
     assert got.complete
 
 
+@pytest.mark.parametrize("prefetch", ["on", "off"])
 @pytest.mark.parametrize("host_dedup", ["on", "off"])
-def test_violation_trace_replays_and_stops_exactly(host_dedup, monkeypatch):
+def test_violation_trace_replays_and_stops_exactly(host_dedup, prefetch,
+                                                   monkeypatch):
     monkeypatch.setenv("RAFT_TLA_HOSTDEDUP", host_dedup)
+    monkeypatch.setenv("RAFT_TLA_PREFETCH", prefetch)
     from raft_tla_tpu.models import invariants as inv_mod
     from raft_tla_tpu.models import spec as S
     from raft_tla_tpu.ops import msgbits as mb
@@ -133,9 +136,11 @@ def test_symmetry_composes():
     assert got.coverage == ref.coverage
 
 
+@pytest.mark.parametrize("prefetch", ["on", "off"])
 @pytest.mark.parametrize("host_dedup", ["on", "off"])
-def test_deadlock_detected(host_dedup, monkeypatch):
+def test_deadlock_detected(host_dedup, prefetch, monkeypatch):
     monkeypatch.setenv("RAFT_TLA_HOSTDEDUP", host_dedup)
+    monkeypatch.setenv("RAFT_TLA_PREFETCH", prefetch)
     cfg = CheckConfig(bounds=Bounds(n_servers=1, n_values=1, max_term=2,
                                     max_log=0, max_msgs=2),
                       spec="election", invariants=(), chunk=16,
@@ -319,6 +324,76 @@ def test_deadline_stops_cleanly():
     assert not got.complete
     assert 1 <= got.n_states < 142538
     assert got.violation is None
+
+
+# -- RAFT_TLA_PREFETCH gate (double-buffered upload prefetch) ---------------
+
+
+@pytest.mark.parametrize("retention", ["full", "frontier"])
+@pytest.mark.parametrize("prefetch", ["on", "off"])
+def test_prefetch_oracle_parity_both_arms(prefetch, retention,
+                                          monkeypatch):
+    """Explicit both-arm parity in both retention modes: swapping block
+    uploads to prefetched, double-buffered staging must not move a
+    single byte of discovery (hits and misses read the same rows)."""
+    monkeypatch.setenv("RAFT_TLA_PREFETCH", prefetch)
+    ref = refbfs.check(CFG)
+    caps = DDDCapacities(block=256, table=1 << 14, flush=1 << 10,
+                         levels=64, retention=retention)
+    got = DDDEngine(CFG, caps).check()
+    assert got.n_states == ref.n_states == 3014
+    assert got.levels == ref.levels
+    assert got.n_transitions == ref.n_transitions
+    assert got.coverage == ref.coverage
+    assert got.violation is None and got.complete
+
+
+def test_prefetch_checkpoint_cross_gate(tmp_path, monkeypatch):
+    """Checkpoints are prefetch-agnostic (the gate is deliberately not
+    part of the digest): written under either arm, resumable under the
+    other, byte-identical finals both ways."""
+    straight = DDDEngine(CFG, CAPS).check()
+    for write, read in (("on", "off"), ("off", "on")):
+        ck = str(tmp_path / f"ddd_pf_{write}.ckpt")
+        monkeypatch.setenv("RAFT_TLA_PREFETCH", write)
+        mid = DDDEngine(CFG, CAPS).check(checkpoint=ck,
+                                         checkpoint_every_s=0.0)
+        assert mid.n_states == straight.n_states
+        monkeypatch.setenv("RAFT_TLA_PREFETCH", read)
+        resumed = DDDEngine(CFG, CAPS).check(resume=ck)
+        assert resumed.n_states == straight.n_states, (write, read)
+        assert resumed.levels == straight.levels
+        assert resumed.n_transitions == straight.n_transitions
+        assert resumed.coverage == straight.coverage
+        assert resumed.violation is None
+
+
+def test_prefetch_lossless_deadline_stop_with_prefetch_in_flight(
+        tmp_path, monkeypatch):
+    """The lossless-stop contract with BOTH background threads live: a
+    deadline lands while a flush may be in flight on the dedup worker
+    AND a block prefetch may be staged or in flight; the stop path
+    invalidates the prefetch and drains the queue before the snapshot,
+    so resume completes byte-identical to an uninterrupted run."""
+    monkeypatch.setenv("RAFT_TLA_HOSTDEDUP", "on")
+    monkeypatch.setenv("RAFT_TLA_PREFETCH", "on")
+    cfg = CheckConfig(bounds=Bounds(n_servers=3, n_values=1, max_term=2,
+                                    max_log=0, max_msgs=1),
+                      spec="election", invariants=("NoTwoLeaders",),
+                      chunk=64)
+    caps = DDDCapacities(block=256, table=1 << 14, flush=1 << 9, levels=64)
+    straight = DDDEngine(cfg, caps).check()
+    ck = str(tmp_path / "pf_dl.ckpt")
+    got = DDDEngine(cfg, caps).check(deadline_s=0.5, checkpoint=ck,
+                                     checkpoint_every_s=3600.0)
+    assert not got.complete
+    assert got.n_states < straight.n_states
+    resumed = DDDEngine(cfg, caps).check(resume=ck)
+    assert resumed.complete
+    assert resumed.n_states == straight.n_states
+    assert resumed.levels == straight.levels
+    assert resumed.n_transitions == straight.n_transitions
+    assert resumed.coverage == straight.coverage
 
 
 # -- EP-routed step (DDDCapacities.route_rows; SURVEY §2.9 EP row) ----------

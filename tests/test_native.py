@@ -93,6 +93,106 @@ def test_cpp_store_matches_py_store_on_random_ops():
     a.close()
 
 
+@pytest.mark.parametrize("cls", [native.HostStore, native.PyHostStore])
+def test_store_concurrent_append_and_disjoint_reads(cls):
+    """The one-appender + disjoint-range-reader contract the upload
+    prefetch rests on (utils/prefetch.py): a reader of rows below a
+    previously observed ``len()`` must see exactly those rows while an
+    appender thread keeps publishing past them — native (atomic block
+    directory, release-published size) and fallback (snapshot reads)
+    alike.  Block size is 65536 rows, so 3000-row appends cross block
+    and chunk-internal boundaries repeatedly."""
+    if cls is native.HostStore and not native.HAS_NATIVE:
+        pytest.skip("no toolchain")
+    import threading
+    width, n_batches, rows_per = 6, 64, 3000
+    rng = np.random.default_rng(11)
+    batches = [rng.integers(-9, 9, size=(rows_per, width), dtype=np.int32)
+               for _ in range(n_batches)]
+    ref = np.concatenate(batches)
+    st = cls(width=width)
+    st.append(batches[0])
+    published = threading.Event()
+    errors = []
+
+    def appender():
+        try:
+            for b in batches[1:]:
+                st.append(b)
+                published.set()
+        except BaseException as e:     # noqa: BLE001 — surfaced below
+            errors.append(e)
+            published.set()
+
+    t = threading.Thread(target=appender)
+    t.start()
+    try:
+        reads = 0
+        while t.is_alive() or reads < 50:
+            hi = len(st)               # observe a published size...
+            lo = max(0, hi - 2048)
+            got = st.read(lo, hi - lo)  # ...then read only below it
+            np.testing.assert_array_equal(got, ref[lo:hi])
+            reads += 1
+            if not t.is_alive() and reads >= 50:
+                break
+    finally:
+        t.join()
+    assert not errors, errors
+    assert len(st) == ref.shape[0]
+    np.testing.assert_array_equal(st.read(0, len(st)), ref)
+    st.close()
+
+
+def test_store_bounds_error_messages_native_fallback_parity():
+    """read / read_links / trace_chain must fail with the SAME
+    IndexError text on both backends — the engines and the prefetch
+    layer treat these as one store type."""
+    if not native.HAS_NATIVE:
+        pytest.skip("no toolchain")
+    stores = [native.HostStore(3), native.PyHostStore(3)]
+    rows = np.arange(30, dtype=np.int32).reshape(10, 3)
+    parent = np.asarray([-1, 0, 1], np.int32)
+    lane = np.asarray([-1, 4, 5], np.int32)
+    msgs = []
+    for st in stores:
+        st.append(rows)
+        st.append_links(parent, lane)
+        got = []
+        for fn in (lambda: st.read(8, 5),
+                   lambda: st.read_links(1, 9),
+                   lambda: st.trace_chain(7)):
+            with pytest.raises(IndexError) as ei:
+                fn()
+            got.append(str(ei.value))
+        msgs.append(got)
+        st.close()
+    assert msgs[0] == msgs[1], msgs
+
+
+def test_filestore_truncated_stream_diagnostic(tmp_path):
+    """A stream file shorter than its committed header (torn copy,
+    partial restore) must fail loudly with path + expected/got rows,
+    not die inside a reshape."""
+    import os
+    path = str(tmp_path / "trunc.rows")
+    st = native.FileStore(path, width=4)
+    st.append(np.arange(400, dtype=np.int32).reshape(100, 4))
+    st.sync()
+    st.close()
+    size = os.path.getsize(path)
+    os.truncate(path, size - 10 * 4 * 4)     # drop the last 10 rows
+    st = native.FileStore(path, width=4)
+    np.testing.assert_array_equal(
+        st.read(0, 90),
+        np.arange(360, dtype=np.int32).reshape(90, 4))
+    with pytest.raises(ValueError) as ei:
+        st.read(0, 100)
+    msg = str(ei.value)
+    assert path in msg and "expected 100 rows" in msg and "got 90" in msg
+    st.close()
+
+
 def test_scc_csr_native_matches_python_fallback():
     """Both scc_csr implementations must induce the same partition
     (component ids may differ; membership must not) on random digraphs."""

@@ -1,0 +1,200 @@
+"""Upload-prefetch layer (utils/prefetch.py).
+
+The `BlockPrefetcher` sits between the DDD harvest loops and the host
+stores; its gates are protocol-level: hits return exactly what the
+loader produced for the requested range, misses fall back to a
+synchronous load on the caller's thread, invalidation discards staged
+AND in-flight work before returning (so stop paths and frontier
+rotations never race a store read), stale generations are dropped, and
+worker exceptions surface on the main thread — never silently.
+"""
+
+import threading
+import time
+
+import pytest
+
+from raft_tla_tpu.utils import prefetch
+from raft_tla_tpu.utils.prefetch import BlockPrefetcher, prefetch_enabled
+
+pytestmark = pytest.mark.smoke
+
+
+# -- gate resolution --------------------------------------------------------
+
+
+def test_gate_forced_arms():
+    assert prefetch_enabled("on") is True
+    assert prefetch_enabled("off") is False
+    assert prefetch_enabled(" ON ") is True     # trimmed, case-folded
+    assert prefetch_enabled("OFF") is False
+
+
+def test_gate_auto_follows_cpu_count(monkeypatch):
+    import os
+    monkeypatch.setattr(os, "cpu_count", lambda: 1)
+    assert prefetch_enabled("auto") is False
+    monkeypatch.setattr(os, "cpu_count", lambda: 4)
+    assert prefetch_enabled("auto") is True
+    monkeypatch.setattr(os, "cpu_count", lambda: None)
+    assert prefetch_enabled("auto") is False
+
+
+def test_gate_reads_env(monkeypatch):
+    monkeypatch.setenv(prefetch.ENV_PREFETCH, "on")
+    assert prefetch_enabled() is True
+    monkeypatch.setenv(prefetch.ENV_PREFETCH, "off")
+    assert prefetch_enabled() is False
+
+
+# -- hit / miss protocol ----------------------------------------------------
+
+
+def _tracking_loader(calls):
+    def loader(start, rows, slot):
+        calls.append((start, rows, slot, threading.current_thread().name))
+        return ("data", start, rows)
+    return loader
+
+
+def test_scheduled_take_is_a_hit():
+    calls = []
+    pf = BlockPrefetcher(_tracking_loader(calls))
+    try:
+        pf.schedule(0, 256)
+        assert pf.take(0, 256) == ("data", 0, 256)
+        assert pf.hits == 1 and pf.misses == 0
+        # the hit ran on the worker thread, not the caller
+        assert calls == [(0, 256, 0, "raft-tla-prefetch")]
+    finally:
+        pf.close()
+
+
+def test_unscheduled_take_is_a_miss_on_caller_thread():
+    calls = []
+    pf = BlockPrefetcher(_tracking_loader(calls))
+    try:
+        assert pf.take(512, 128) == ("data", 512, 128)
+        assert pf.hits == 0 and pf.misses == 1
+        assert calls[0][:2] == (512, 128)
+        assert calls[0][3] == threading.current_thread().name
+    finally:
+        pf.close()
+
+
+def test_range_mismatch_is_a_miss():
+    """A take whose range doesn't match the staged result must reload
+    synchronously — the engine gets the bytes it asked for, always."""
+    calls = []
+    pf = BlockPrefetcher(_tracking_loader(calls))
+    try:
+        pf.schedule(0, 256)
+        assert pf.take(0, 200) == ("data", 0, 200)   # shrunk block
+        assert pf.hits == 0 and pf.misses == 1
+    finally:
+        pf.close()
+
+
+def test_slots_round_robin():
+    calls = []
+    pf = BlockPrefetcher(_tracking_loader(calls), slots=2)
+    try:
+        for i in range(4):
+            pf.schedule(i * 256, 256)
+            pf.take(i * 256, 256)
+        assert [c[2] for c in calls] == [0, 1, 0, 1]
+        assert pf.hits == 4
+    finally:
+        pf.close()
+
+
+# -- invalidation (stop events, level boundaries) ---------------------------
+
+
+def test_invalidate_discards_staged_result():
+    calls = []
+    pf = BlockPrefetcher(_tracking_loader(calls))
+    try:
+        pf.schedule(0, 256)
+        pf.invalidate()                       # level boundary / stop
+        assert pf.take(0, 256) == ("data", 0, 256)
+        assert pf.hits == 0 and pf.misses == 1
+    finally:
+        pf.close()
+
+
+def test_invalidate_waits_for_in_flight_worker():
+    """invalidate() must not return while the loader is mid-read: a
+    frontier rotation after it returns would otherwise race the store."""
+    entered = threading.Event()
+    release = threading.Event()
+    done = []
+
+    def loader(start, rows, slot):
+        entered.set()
+        release.wait(timeout=10.0)
+        done.append(time.perf_counter())
+        return "late"
+
+    pf = BlockPrefetcher(loader)
+    try:
+        pf.schedule(0, 256)
+        assert entered.wait(timeout=10.0)
+        t = threading.Timer(0.05, release.set)
+        t.start()
+        pf.invalidate()                       # must block until loader exits
+        assert done, "invalidate returned while the loader was in flight"
+        # and the stale result was dropped: next take is a miss
+        calls = []
+        pf._loader = _tracking_loader(calls)
+        assert pf.take(0, 256) == ("data", 0, 256)
+        assert pf.misses == 1
+        t.cancel()
+    finally:
+        release.set()
+        pf.close()
+
+
+def test_invalidate_never_raises_after_worker_error():
+    def boom(start, rows, slot):
+        raise ValueError("store exploded")
+
+    pf = BlockPrefetcher(boom)
+    try:
+        pf.schedule(0, 256)
+        deadline = time.perf_counter() + 10.0
+        while pf._exc is None and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        pf.invalidate()                       # stop paths: must not raise
+        with pytest.raises(RuntimeError, match="upload prefetch failed"):
+            pf.schedule(256, 256)
+    finally:
+        pf.close()
+
+
+# -- worker exceptions ------------------------------------------------------
+
+
+def test_worker_exception_reraises_at_take():
+    def boom(start, rows, slot):
+        raise ValueError("store exploded")
+
+    pf = BlockPrefetcher(boom)
+    try:
+        pf.schedule(0, 256)
+        with pytest.raises(RuntimeError, match="upload prefetch failed"):
+            pf.take(0, 256)
+    finally:
+        pf.close()
+
+
+# -- close ------------------------------------------------------------------
+
+
+def test_close_is_idempotent_and_schedule_after_close_raises():
+    pf = BlockPrefetcher(_tracking_loader([]))
+    pf.close()
+    pf.close()
+    assert not pf._t.is_alive()
+    with pytest.raises(RuntimeError, match="closed"):
+        pf.schedule(0, 256)

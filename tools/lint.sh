@@ -133,6 +133,30 @@ off_line="$(grep '^3014 distinct states found' "$SERVE_TMP/hostdedup_off.out" \
          echo "  on:  $on_line"; echo "  off: $off_line"; exit 1; }
 echo "host-dedup smoke ok: on/off byte-identical ($on_line)"
 
+echo "== prefetch smoke (ddd engine, double-buffered upload staging, CPU) =="
+# Gate forced ON: the toy cfg runs end-to-end through the ddd engine
+# with block uploads served from the background prefetch thread, then
+# again with the gate OFF — the result lines (counts, diameter,
+# transitions; wall stripped) must be byte-identical.
+python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
+    --spec election --max-term 2 --max-log 0 --max-msgs 2 \
+    --engine ddd --chunk 32 --prefetch on --cpu --no-lint --no-trace \
+    | tee "$SERVE_TMP/prefetch_on.out" | tail -2
+grep -q "^3014 distinct states found" "$SERVE_TMP/prefetch_on.out" \
+    || { echo "prefetch smoke FAILED: expected 3014 states"; exit 1; }
+python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
+    --spec election --max-term 2 --max-log 0 --max-msgs 2 \
+    --engine ddd --chunk 32 --prefetch off --cpu --no-lint --no-trace \
+    > "$SERVE_TMP/prefetch_off.out"
+on_line="$(grep '^3014 distinct states found' "$SERVE_TMP/prefetch_on.out" \
+    | sed 's/, [0-9.]*s.*//')"
+off_line="$(grep '^3014 distinct states found' "$SERVE_TMP/prefetch_off.out" \
+    | sed 's/, [0-9.]*s.*//')"
+[ "$on_line" = "$off_line" ] \
+    || { echo "prefetch smoke FAILED: on/off result lines differ"; \
+         echo "  on:  $on_line"; echo "  off: $off_line"; exit 1; }
+echo "prefetch smoke ok: on/off byte-identical ($on_line)"
+
 echo "== chaos smoke (campaign SIGKILL + reshard 1->2->1, CPU) =="
 # The campaign supervisor's acceptance loop in miniature: reference run,
 # then SIGKILL after the 2nd checkpoint, auto-reshard across a 1->2->1
