@@ -48,6 +48,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import random
 import shutil
 import signal
 import subprocess
@@ -85,6 +86,10 @@ class CampaignPolicy:
     max_resumes: int = 8                 # bounded unattended retries
     backoff_base_s: float = 0.5
     backoff_cap_s: float = 30.0
+    backoff_jitter_seed: int | None = None
+    #   decorrelated-jitter RNG seed; None derives one from the pid so
+    #   co-located supervisors never retry in lockstep, an explicit int
+    #   makes the whole delay sequence reproducible (tests pin it)
     grace_s: float = 20.0                # SIGINT -> SIGKILL window
     poll_s: float = 0.25                 # supervisor loop period
     retain_generations: int = 2          # known-good snapshot copies
@@ -128,7 +133,10 @@ class CampaignResult:
 
 class _LogTail:
     """Incremental JSONL tailer: byte-offset resume, partial-line safe
-    (a half-written line stays buffered until its newline lands)."""
+    (a half-written line stays buffered until its newline lands), and
+    truncation-aware — a log rewritten/rotated underneath us (file
+    shrank below our offset) resets the tail to the start of the new
+    content instead of reading from a stale offset forever."""
 
     def __init__(self, path: str):
         self.path = path
@@ -144,6 +152,9 @@ class _LogTail:
 
     def poll(self) -> list:
         try:
+            if os.path.getsize(self.path) < self._pos:
+                self._pos = 0            # truncated under us: re-anchor
+                self._buf = ""
             with open(self.path, "r", encoding="utf-8") as f:
                 f.seek(self._pos)
                 chunk = f.read()
@@ -172,6 +183,41 @@ def _median(xs: list) -> float:
     s = sorted(xs)
     n = len(s)
     return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+class DecorrelatedBackoff:
+    """Seedable decorrelated-jitter retry delays (the AWS-architecture
+    variant: ``next = min(cap, uniform(base, prev * 3))``).
+
+    Pure exponential backoff retries co-located supervisors (and the
+    serve worker pool's respawns) in lockstep — every failed host wakes
+    at the same instants and thunders the shared allocation together.
+    Decorrelated jitter spreads the wakeups while keeping the same mean
+    growth; seeding it makes the *whole sequence* deterministic, so the
+    anti-herd behavior itself is testable (and two supervisors seeded
+    differently provably diverge).  ``seed=None`` derives one from the
+    pid: distinct processes get distinct sequences by default.
+    """
+
+    def __init__(self, base_s: float, cap_s: float,
+                 seed: int | None = None):
+        self.base_s = base_s
+        self.cap_s = cap_s
+        if seed is None:
+            seed = os.getpid()
+        self._seed = seed
+        self._rng = random.Random(seed)
+        self._prev = base_s
+
+    def reset(self) -> None:
+        """Progress was made: the next failure backs off from base
+        again (the RNG stream keeps advancing — only the window resets)."""
+        self._prev = self.base_s
+
+    def next(self) -> float:
+        self._prev = min(self.cap_s,
+                         self._rng.uniform(self.base_s, self._prev * 3.0))
+        return self._prev
 
 
 class HealthMonitor:
@@ -306,6 +352,10 @@ class Supervisor:
         self._external: tuple | None = None
         self.config = None
         self.quarantined: list = []
+        self._jitter = DecorrelatedBackoff(
+            self.policy.backoff_base_s, self.policy.backoff_cap_s,
+            seed=self.policy.backoff_jitter_seed)
+        self._last_backoff_s = 0.0
 
     # ---------------------------------------------------------------- util
 
@@ -670,7 +720,7 @@ class Supervisor:
             if spawns:
                 extra = {"path": self.ckpt, "ndev": ndev}
                 if backoff_k:
-                    extra["backoff_s"] = round(self._backoff(backoff_k), 3)
+                    extra["backoff_s"] = round(self._last_backoff_s, 3)
                 if self.quarantined:
                     extra["quarantined"] = self.quarantined[-1][0]
                 append_event(self.sup_events, "resume_attempt",
@@ -713,10 +763,17 @@ class Supervisor:
                 self.sleep(delay)
 
     def _backoff(self, k: int) -> float:
+        """Delay before retry ``k`` of the current no-progress streak:
+        0 resets the jitter window (progress was made), k >= 1 draws the
+        next decorrelated-jitter delay.  Stateful — call once per retry
+        decision; the drawn value is kept in ``_last_backoff_s`` for the
+        resume_attempt event."""
         if k <= 0:
+            self._jitter.reset()
+            self._last_backoff_s = 0.0
             return 0.0
-        return min(self.policy.backoff_cap_s,
-                   self.policy.backoff_base_s * (2.0 ** (k - 1)))
+        self._last_backoff_s = self._jitter.next()
+        return self._last_backoff_s
 
     def _result(self, outcome: str, rc: int, end, spawns: int,
                 preempts: int, reshards: int,

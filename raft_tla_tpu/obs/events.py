@@ -92,12 +92,29 @@ optional, both invalid on a ``"v" < 6`` line:
                            blocks - hits; the in-engine warm rate
                            runs/prefetch_ab.py reports)
 
+Version 7 adds the serve worker-pool supervision lifecycle (emitted by
+``raft_tla_tpu/serve/pool``, never by the engines themselves) — all four
+event types invalid on a ``"v" < 7`` line:
+
+``worker_spawn``   worker, pid [+ jobs, bins, chunk, respawn, attempt]
+                   (a pool worker child came up, with its job assignment
+                    and the dispatch width it was granted)
+``worker_lost``    worker, kind [+ pid, exit_code, jobs, detail]
+                   (the pool reaped a dead/preempted worker; ``kind`` is
+                    the death classification: killed / segfault / oom /
+                    signal / crashed / heartbeat-stale / session-wall)
+``job_retry``      job_id, attempt [+ worker, backoff_s, reason]
+                   (a surviving job was requeued to a fresh worker)
+``quarantine``     job_id, reason [+ deaths, worker, detail]
+                   (poison verdict: the job killed its worker K times
+                    and will never be executed again)
+
 A run log with no ``run_end`` means the process died — crash attribution
 for free.  The schema is strict: unknown fields fail validation and the
-v2-only event types (resp. v3/v4/v5/v6-only fields) are invalid on a
-``"v": 1`` (resp. ``"v" < 3`` / ``"v" < 4`` / ``"v" < 5`` / ``"v" < 6``)
-line, so any addition requires a version bump (versioning policy in
-README.md).
+v2/v7-only event types (resp. v3/v4/v5/v6-only fields) are invalid on a
+``"v" < 2`` / ``"v" < 7`` (resp. ``"v" < 3`` / ``"v" < 4`` / ``"v" < 5``
+/ ``"v" < 6``) line, so any addition requires a version bump (versioning
+policy in README.md).
 """
 
 from __future__ import annotations
@@ -110,8 +127,8 @@ import subprocess
 import threading
 import time
 
-SCHEMA_VERSION = 6
-_VERSIONS = (1, 2, 3, 4, 5, 6)  # versions validate_event accepts
+SCHEMA_VERSION = 7
+_VERSIONS = (1, 2, 3, 4, 5, 6, 7)  # versions validate_event accepts
 
 # Environment knobs (set by check.py --events/--phase-timers; inherited by
 # liveness re-runs and bench children the same way RAFT_TLA_SIGPRUNE is).
@@ -165,11 +182,20 @@ _REQUIRED = {
     "preempt": {"reason": str},
     "reshard": {"ndev_src": int, "ndev_dst": int},
     "resume_attempt": {"attempt": int},
+    "worker_spawn": {"worker": str, "pid": int},
+    "worker_lost": {"worker": str, "kind": str},
+    "job_retry": {"job_id": str, "attempt": int},
+    "quarantine": {"job_id": str, "reason": str},
 }
 
 # Event types that only exist from schema version 2 on (the campaign
 # supervisor lifecycle) — invalid on a "v": 1 line.
 _V2_EVENTS = frozenset({"preempt", "reshard", "resume_attempt"})
+
+# Event types that only exist from schema version 7 on (the serve
+# worker-pool supervision lifecycle) — invalid on a "v" < 7 line.
+_V7_EVENTS = frozenset({"worker_spawn", "worker_lost", "job_retry",
+                        "quarantine"})
 
 # Fields that only exist from schema version 3 on (walker-fleet
 # statistical checking) — invalid on a "v" < 3 line.
@@ -208,6 +234,12 @@ _OPTIONAL = {
     "reshard": {"n_states": int, "path": str, "block": int},
     "resume_attempt": {"path": str, "ndev": int, "backoff_s": _NUM,
                        "quarantined": str},
+    "worker_spawn": {"jobs": list, "bins": int, "chunk": int,
+                     "respawn": bool, "attempt": int},
+    "worker_lost": {"pid": int, "exit_code": int, "jobs": list,
+                    "detail": str},
+    "job_retry": {"worker": str, "backoff_s": _NUM, "reason": str},
+    "quarantine": {"deaths": int, "worker": str, "detail": str},
 }
 
 
@@ -235,6 +267,8 @@ def validate_event(d: dict) -> list:
         return errs + [f"unknown event type {ev!r}"]
     if ev in _V2_EVENTS and d["v"] in _VERSIONS and d["v"] < 2:
         errs.append(f"{ev}: event type requires schema version >= 2")
+    if ev in _V7_EVENTS and d["v"] in _VERSIONS and d["v"] < 7:
+        errs.append(f"{ev}: event type requires schema version >= 7")
     req, opt = _REQUIRED[ev], _OPTIONAL[ev]
     for k, spec in req.items():
         if k not in d:

@@ -199,14 +199,38 @@ def _staleness(events: list, now: float,
     return age, cadence, age > stale_after_s
 
 
+def _pool_counts(events: list) -> dict | None:
+    """Aggregate the v7 worker-pool supervision lifecycle (pool.events):
+    spawn/loss/retry/quarantine counters plus the last loss kind and the
+    quarantined job ids — the pool's end-state attribution row."""
+    spawns = [e for e in events if e["event"] == "worker_spawn"]
+    losses = [e for e in events if e["event"] == "worker_lost"]
+    retries = [e for e in events if e["event"] == "job_retry"]
+    quar = [e for e in events if e["event"] == "quarantine"]
+    if not (spawns or losses or retries or quar):
+        return None
+    pool = {"spawns": len(spawns), "losses": len(losses),
+            "retries": len(retries),
+            "quarantined": [e["job_id"] for e in quar]}
+    if losses:
+        pool["last_loss_kind"] = losses[-1]["kind"]
+    return pool
+
+
 def summarize(stream: dict, window_s: float = 600.0,
               target: int | None = None, now: float | None = None,
               stale_after_s: float | None = None) -> dict | None:
     """Distil a loaded stream into the heartbeat fields (None = no data)."""
     segments = stream["segments"]
     events = stream["events"]
+    pool = _pool_counts(events)
     if not segments:
-        return None
+        if pool is None:
+            return None
+        # A pure supervision log (serve pool.events) has no segment
+        # timeline; the pool lifecycle IS the heartbeat.
+        return {"pool": pool, "pool_only": True,
+                "n_invalid": len(stream["invalid"])}
     cur = segments[-1]
     summary = {
         "level": cur["level"],
@@ -222,6 +246,7 @@ def summarize(stream: dict, window_s: float = 600.0,
         "target": target,
         "legacy": stream["legacy"],
         "n_invalid": len(stream["invalid"]),
+        "pool": pool,
     }
     summary["eta_s"] = _eta_s(summary)
 
@@ -283,10 +308,25 @@ def _fmt_eta(s: float) -> str:
     return f"{s / 3600:.1f}h"
 
 
+def _fmt_pool(pool: dict) -> str:
+    tag = (f"pool: {pool['spawns']} spawn(s), {pool['losses']} lost, "
+           f"{pool['retries']} retried")
+    if pool.get("last_loss_kind"):
+        tag += f" (last loss: {pool['last_loss_kind']})"
+    if pool["quarantined"]:
+        tag += f", QUARANTINED {','.join(pool['quarantined'])}"
+    return tag
+
+
 def heartbeat(summary: dict | None) -> str:
     """Render the one-line heartbeat."""
     if summary is None:
         return "obs: no segments yet"
+    if summary.get("pool_only"):
+        line = _fmt_pool(summary["pool"])
+        if summary["n_invalid"]:
+            line += f"  [{summary['n_invalid']} invalid lines]"
+        return line
     parts = [
         f"L{summary['level']}",
         f"{summary['n_states']:,} st",
@@ -317,6 +357,8 @@ def heartbeat(summary: dict | None) -> str:
         # ddd background host dedup: 1 = a sealed flush was overlapping
         # device compute at the segment boundary (depth-1 worker)
         parts.append(f"flush backlog {summary['flush_backlog']}")
+    if summary.get("pool"):
+        parts.append(_fmt_pool(summary["pool"]))
     if summary.get("last_event_age_s") is not None:
         parts.append(f"last ev {summary['last_event_age_s']:.0f}s ago")
     parts.append(summary["status"])

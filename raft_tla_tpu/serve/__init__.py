@@ -26,6 +26,15 @@ subsystem that accepts N jobs and amortizes device dispatch across them:
   job-queue directory, emits one obs/ SCHEMA_VERSION=1 event log per
   job (``raft-tla-monitor`` works unchanged per tenant), and isolates
   tenants by per-job config digests in every result record.
+- :mod:`raft_tla_tpu.serve.pool` + :mod:`raft_tla_tpu.serve.supervise`
+  — fault-isolated serving (``--workers N``): admitted jobs dispatch
+  to supervised worker child processes (health via the campaign
+  supervisor's ``_LogTail``/``HealthMonitor``), with death
+  classification, poison-job bisection + quarantine, per-job wall
+  budgets, OOM chunk-halving degradation and bounded jittered
+  respawns.  :mod:`raft_tla_tpu.serve.chaos` is the fault-injection
+  harness asserting pool artifacts stay canonically identical to an
+  unsupervised solo pass.
 """
 
 from raft_tla_tpu.serve.jobs import (Admission, CheckJob, JobOptions,

@@ -86,7 +86,7 @@ class _Lane:
 
     def __init__(self, job_id: str, config: CheckConfig, table, lay,
                  tel: RunTelemetry | None = None, init_override=None,
-                 model=None):
+                 model=None, wall_s: float | None = None):
         if model is None:
             from raft_tla_tpu.frontend import resolve_model
             model = resolve_model(config.spec)
@@ -97,6 +97,7 @@ class _Lane:
         self.A = len(table)
         self.lay = lay
         self.tel = tel
+        self.wall_s = wall_s            # per-job wall budget (JobOptions)
         self.t0 = time.monotonic()
 
         bounds = config.bounds
@@ -241,6 +242,17 @@ class _Lane:
                              inflight=inflight)
         if max_states is not None and len(self.store) > max_states:
             raise _LaneFailure(f"state count exceeded {max_states}")
+        if self.wall_s is not None:
+            spent = time.monotonic() - self.t0
+            if spent > self.wall_s:
+                # lossless deadline stop, the engines' --deadline analog:
+                # the level boundary is a consistent cut, so every count
+                # this lane reported stands and the record attributes the
+                # stop to the tenant's own budget, not a service fault
+                raise _LaneFailure(
+                    f"budget-exceeded: wall {spent:.3f}s over the "
+                    f"{self.wall_s:g}s wall_s budget (lossless "
+                    "level-boundary stop)")
         self.frontier = self.next_frontier
         self.next_frontier = []
         self.cursor = 0
@@ -344,14 +356,19 @@ class BatchExecutor:
         self.last_stats: dict | None = None   # scheduler stats of last run
 
     def run(self, jobs, telemetry: dict | None = None,
-            init_overrides: dict | None = None) -> dict:
+            init_overrides: dict | None = None,
+            budgets: dict | None = None) -> dict:
         """``jobs``: iterable of ``(job_id, CheckConfig)``; ``telemetry``
         optionally maps job_id -> RunTelemetry (the service wires one
         per-job event log each; callers owning none pass nothing).
         ``init_overrides`` maps job_id -> PyState, mirroring the solo
-        engines' ``init_override`` hook (parity tests seed from it)."""
+        engines' ``init_override`` hook (parity tests seed from it).
+        ``budgets`` maps job_id -> wall seconds (``JobOptions.wall_s``):
+        an over-budget lane is stopped losslessly at its next level
+        boundary with a ``budget-exceeded`` record."""
         telemetry = telemetry or {}
         init_overrides = init_overrides or {}
+        budgets = budgets or {}
         bins: dict[tuple, _Bin] = {}
         outcomes: dict[str, LaneOutcome] = {}
         lanes: list[_Lane] = []
@@ -366,7 +383,7 @@ class BatchExecutor:
             lane = _Lane(job_id, config, bn.table, bn.lay,
                          tel=telemetry.get(job_id),
                          init_override=init_overrides.get(job_id),
-                         model=bn.model)
+                         model=bn.model, wall_s=budgets.get(job_id))
             lane.bin_tag = bn.tag
             bn.lanes.append(lane)
             lanes.append(lane)

@@ -51,6 +51,10 @@ class JobOptions:
     view: str | None = None         # --view: registered exact view
     deadlock: bool = False
     properties: tuple = ()          # --property additions (cfg's also read)
+    wall_s: float | None = None     # per-job wall budget: the lane is
+    #                                 stopped losslessly at the first
+    #                                 level boundary past this many
+    #                                 seconds (budget-exceeded record)
 
 
 def resolve_check_config(cfg: TLCConfig, opts: JobOptions,
@@ -214,6 +218,20 @@ class CheckJob:
         return cls(job_id=str(jid), options=opts,
                    cfg_path=cfg_path, cfg_text=cfg_text)
 
+    def to_dict(self) -> dict:
+        """The inverse of :meth:`from_dict`, with the cfg TEXT inlined —
+        the self-contained job form the worker pool writes into per-child
+        manifests (a child must not depend on the parent's cwd or on a
+        cfg file still existing).  ``from_dict(to_dict(j))`` digests
+        identically to ``j`` (the digest covers text, never path)."""
+        d = {"id": self.job_id, "cfg_text": self.read_cfg_text()}
+        defaults = JobOptions()
+        for f in dataclasses.fields(JobOptions):
+            v = getattr(self.options, f.name)
+            if v != getattr(defaults, f.name):
+                d[f.name] = list(v) if isinstance(v, tuple) else v
+        return d
+
 
 # --------------------------------------------------------------------------
 # admission
@@ -255,7 +273,17 @@ def admit(job: CheckJob) -> Admission:
     from raft_tla_tpu.frontend import resolve_model
 
     opts = job.options
-    # Spec name first: an unknown spec must be a lint-style finding, not
+    # Budget first: a zero/negative/non-numeric wall_s is a client error
+    # the lint gate must name, never a traceback out of the queue worker
+    # (and never a job the executor starts only to stop at once).
+    w = opts.wall_s
+    if w is not None and (type(w) not in (int, float) or w <= 0):
+        f = _report.Finding(
+            _report.CFG, _report.ERROR, "budget-invalid",
+            f"wall_s must be a positive number of seconds, got {w!r}",
+            field="wall_s")
+        return Admission(job, False, [f], reason="budget-invalid")
+    # Spec name next: an unknown spec must be a lint-style finding, not
     # a traceback out of the queue worker.
     try:
         model = resolve_model(opts.spec)

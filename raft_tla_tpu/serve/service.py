@@ -223,9 +223,12 @@ def run_service(jobs, out_dir: str, chunk: int = 1024,
         ex = BatchExecutor(chunk=chunk, max_states=max_states,
                            depth=depth, compile_async=compile_async,
                            stop=stop)
+        budgets = {job.job_id: job.options.wall_s
+                   for job, adm, rec in admitted
+                   if job.options.wall_s is not None}
         outcomes = ex.run([(job.job_id, adm.config)
                            for job, adm, rec in admitted],
-                          telemetry=telemetry)
+                          telemetry=telemetry, budgets=budgets)
 
     for job, adm, rec in admitted:
         oc = outcomes[job.job_id]
@@ -249,25 +252,76 @@ def run_service(jobs, out_dir: str, chunk: int = 1024,
             f"diameter {rec.get('diameter', 0)}, "
             f"{rec.get('wall_s', 0.0):.2f}s")
 
-    with open(os.path.join(out_dir, "results.jsonl"), "a",
-              encoding="utf-8") as f:
-        for rec in records:
-            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    _append_records(out_dir, records)
     return records
 
 
 def _append_records(out_dir: str, records: list) -> None:
+    """Crash-safe results append: every record is ONE whole-line write,
+    flushed (and fsynced) before the next — a worker SIGKILLed between
+    records can tear at most the final line, never interleave two
+    records, and O_APPEND keeps concurrent pool workers' lines whole.
+    The torn-tail case is the reader's to forgive (:func:`read_results`),
+    exactly the queue-dir intake contract."""
     os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, "results.jsonl"), "a",
               encoding="utf-8") as f:
         for rec in records:
             f.write(json.dumps(rec, sort_keys=True) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+
+def read_results(out_dir: str) -> list:
+    """Read ``OUT/results.jsonl`` tolerating a torn tail: a crash (or
+    SIGKILLed pool worker) mid-append leaves at most one partial final
+    line, which is dropped — same forgiveness the queue-dir intake
+    extends to producers caught mid-write.  A non-JSON line anywhere
+    else is skipped too (the stream is append-only; one bad line must
+    not hide the records around it).  Missing file = no records."""
+    path = os.path.join(out_dir, "results.jsonl")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.read().split("\n")
+    except OSError:
+        return []
+    records = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue                     # torn/garbled line
+        if isinstance(d, dict) and "job_id" in d:
+            records.append(d)
+    return records
+
+
+# Statuses that settle a job for good: re-running the same digest can
+# only reproduce them (BFS is deterministic), so a daemon restart or a
+# pool requeue never re-runs these — the seed of the digest-keyed result
+# cache (ROADMAP item 7).  A plain drained "stopped" is NOT terminal
+# (the stop was the service's, not the job's); a budget/cap stop IS (the
+# same budget would stop the re-run at the same place).
+def record_is_terminal(rec: dict) -> bool:
+    status = rec.get("status")
+    if status in ("completed", "violation", "deadlock", "rejected",
+                  "quarantined"):
+        return True
+    if status == "stopped":
+        err = rec.get("error") or ""
+        return err.startswith("budget-exceeded") \
+            or err.startswith("state count exceeded")
+    return False
 
 
 def run_daemon(source: str, out_dir: str, chunk: int = 1024,
                max_states: int | None = None, quiet: bool = False,
                depth: int = 2, poll_s: float = 2.0,
-               max_idle_polls: int | None = None) -> int:
+               max_idle_polls: int | None = None, workers: int = 0,
+               cpu: bool = False) -> int:
     """The long-running front: ``raft-tla-serve QUEUE_DIR --watch``.
 
     Continuous intake atop the one-pass queue-dir code path: every poll
@@ -279,6 +333,14 @@ def run_daemon(source: str, out_dir: str, chunk: int = 1024,
     served this daemon's lifetime is rejected as ``duplicate-id``
     *without* touching the original tenant's event log (conflation is
     the thing the digests exist to prevent).
+
+    Restart dedup (the result cache's seed): at startup the daemon reads
+    the existing ``results.jsonl`` (torn-tail tolerant) and any intake
+    whose content digest already has a *terminal* record is skipped, not
+    re-run — a restarted daemon never re-bills device time for work it
+    already finished.  ``workers > 0`` routes every batch through the
+    fault-isolated worker pool (:func:`raft_tla_tpu.serve.pool.run_pool`)
+    instead of executing in-process.
 
     Stop contract (the campaign supervisor's, reused): the FIRST SIGINT
     stops intake and drains — the executor finishes in-flight dispatches
@@ -318,6 +380,13 @@ def run_daemon(source: str, out_dir: str, chunk: int = 1024,
         done: set[str] = set()          # file names fully handled
         attempts: dict[str, int] = {}   # unreadable-file retry counts
         served_ids: set[str] = set()
+        # restart dedup: digest-keyed terminal records survive restarts
+        prior = [r for r in read_results(out_dir)
+                 if record_is_terminal(r)]
+        done_digests = {r["digest"] for r in prior if r.get("digest")}
+        if prior:
+            say(f"restart: {len(done_digests)} terminal digest(s) in "
+                f"{out_dir}/results.jsonl will not be re-run")
         idle = 0
         say(f"watching {source} (poll {poll_s:g}s) -> "
             f"{out_dir}/results.jsonl")
@@ -365,6 +434,14 @@ def run_daemon(source: str, out_dir: str, chunk: int = 1024,
                                       "the first submission"})
                         continue
                     served_ids.add(job.job_id)
+                    try:
+                        dg = job.digest()
+                    except (OSError, ValueError):
+                        dg = None       # unreadable cfg: admission rejects
+                    if dg is not None and dg in done_digests:
+                        say(f"[{job.job_id}] cached: digest {dg} already "
+                            "has a terminal record (not re-run)")
+                        continue
                     batch.append(job)
             if extra_records:
                 for rec in extra_records:
@@ -372,9 +449,19 @@ def run_daemon(source: str, out_dir: str, chunk: int = 1024,
                 _append_records(out_dir, extra_records)
             if batch:
                 idle = 0
-                run_service(batch, out_dir, chunk=chunk,
-                            max_states=max_states, quiet=quiet,
-                            depth=depth, stop=stop.is_set)
+                if workers:
+                    from raft_tla_tpu.serve.pool import run_pool
+                    recs = run_pool(batch, out_dir, workers=workers,
+                                    chunk=chunk, max_states=max_states,
+                                    quiet=quiet, depth=depth, cpu=cpu,
+                                    stop=stop.is_set)
+                else:
+                    recs = run_service(batch, out_dir, chunk=chunk,
+                                       max_states=max_states, quiet=quiet,
+                                       depth=depth, stop=stop.is_set)
+                done_digests |= {r["digest"] for r in recs
+                                 if record_is_terminal(r)
+                                 and r.get("digest")}
                 continue                # re-scan immediately after a batch
             if stop.is_set():
                 break
@@ -438,10 +525,22 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="--watch: exit 0 after N consecutive empty "
                         "polls (smoke-test bound; default: run until "
                         "SIGINT)")
+    p.add_argument("--workers", type=int, default=0, metavar="N",
+                   help="fault-isolated mode: dispatch admitted jobs to "
+                        "up to N supervised worker child processes "
+                        "(serve/pool.py) — a poison job, OOM or segfault "
+                        "kills one worker, not the service; 0 (default) "
+                        "executes in-process")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend")
     p.add_argument("--quiet", action="store_true",
                    help="suppress per-job progress lines")
+    p.add_argument("--drain-on-sigint", action="store_true",
+                   help="one-pass mode: first SIGINT drains losslessly "
+                        "(in-flight dispatches harvested, unfinished "
+                        "lanes get attributed 'stopped' records) instead "
+                        "of aborting — how pool workers are spawned, so "
+                        "a supervisor preempt never loses finished work")
     return p
 
 
@@ -464,7 +563,8 @@ def main(argv=None) -> int:
         return run_daemon(args.source, args.out, chunk=args.chunk,
                           max_states=args.max_states, quiet=args.quiet,
                           depth=args.depth, poll_s=args.poll,
-                          max_idle_polls=args.max_idle_polls)
+                          max_idle_polls=args.max_idle_polls,
+                          workers=args.workers, cpu=args.cpu)
     skipped: list = []
     try:
         jobs = load_jobs(args.source, skipped=skipped)
@@ -474,9 +574,36 @@ def main(argv=None) -> int:
     for name, err in skipped:
         print(f"Warning: skipped unreadable job file {name}: {err}",
               file=sys.stderr)
-    records = run_service(jobs, args.out, chunk=args.chunk,
-                          max_states=args.max_states, quiet=args.quiet,
-                          depth=args.depth)
+    stop = None
+    prev_sigint = None
+    if args.drain_on_sigint:
+        import signal
+        import threading
+        drain = threading.Event()
+
+        def _handler(_signum, _frame):
+            if drain.is_set():
+                signal.signal(signal.SIGINT, prev_sigint)
+                raise KeyboardInterrupt
+            drain.set()
+            print("SIGINT: draining — unfinished lanes get attributed "
+                  "records (SIGINT again aborts raw)", file=sys.stderr,
+                  flush=True)
+
+        if threading.current_thread() is threading.main_thread():
+            prev_sigint = signal.getsignal(signal.SIGINT)
+            signal.signal(signal.SIGINT, _handler)
+        stop = drain.is_set
+    if args.workers:
+        from raft_tla_tpu.serve.pool import run_pool
+        records = run_pool(jobs, args.out, workers=args.workers,
+                           chunk=args.chunk, max_states=args.max_states,
+                           quiet=args.quiet, depth=args.depth,
+                           cpu=args.cpu, stop=stop)
+    else:
+        records = run_service(jobs, args.out, chunk=args.chunk,
+                              max_states=args.max_states, quiet=args.quiet,
+                              depth=args.depth, stop=stop)
     n_by = {}
     for rec in records:
         n_by[rec["status"]] = n_by.get(rec["status"], 0) + 1

@@ -268,11 +268,49 @@ def make_family_at(path, n_states=20):
 
 
 def test_backoff_schedule(tmp_path):
-    sup = make_sup(tmp_path)
+    """Decorrelated jitter: every positive-k delay is drawn from
+    [base, min(cap, 3*prev)], k=0 resets the window, and a fixed seed
+    pins the exact sequence (reproducible anti-thundering-herd)."""
+    policy = CampaignPolicy(backoff_jitter_seed=7)
+    sup = make_sup(tmp_path, policy=policy)
     assert sup._backoff(0) == 0.0
-    assert sup._backoff(1) == 0.5
-    assert sup._backoff(3) == 2.0
-    assert sup._backoff(50) == 30.0      # capped
+    seq = [sup._backoff(k) for k in (1, 2, 3, 4, 5)]
+    prev = policy.backoff_base_s
+    for d in seq:
+        assert policy.backoff_base_s <= d <= policy.backoff_cap_s
+        assert d <= max(policy.backoff_base_s, 3.0 * prev) + 1e-9
+        prev = d
+    # seedable: a sibling supervisor with the same seed replays the
+    # exact sequence; k=0 resets the window but not the RNG stream
+    sup2 = make_sup(tmp_path, policy=policy)
+    assert [sup2._backoff(k) for k in (1, 2, 3, 4, 5)] == seq
+    assert sup._backoff(0) == 0.0
+    d = sup._backoff(1)
+    assert d <= 3.0 * policy.backoff_base_s
+    # the value the resume_attempt event reports is the drawn delay
+    assert sup._last_backoff_s == d
+    # different seeds: decorrelated sequences (the anti-herd property)
+    sup3 = make_sup(tmp_path, policy=CampaignPolicy(backoff_jitter_seed=8))
+    assert [sup3._backoff(k) for k in (1, 2, 3, 4, 5)] != seq
+
+
+def test_backoff_jitter_pinned_sequence(tmp_path):
+    """The exact delays under seed 42 — pinned so a refactor that
+    silently changes the draw order (or de-seeds the RNG) fails loud."""
+    from raft_tla_tpu.campaign.supervisor import DecorrelatedBackoff
+    bo = DecorrelatedBackoff(0.5, 30.0, seed=42)
+    seq = [round(bo.next(), 6) for _ in range(4)]
+    bo2 = DecorrelatedBackoff(0.5, 30.0, seed=42)
+    assert [round(bo2.next(), 6) for _ in range(4)] == seq
+    import random
+    rng = random.Random(42)
+    prev, expect = 0.5, []
+    for _ in range(4):
+        prev = min(30.0, rng.uniform(0.5, prev * 3.0))
+        expect.append(round(prev, 6))
+    assert seq == expect
+    bo2.reset()
+    assert bo2.next() <= 1.5             # window re-anchored at base
 
 
 def test_verify_or_recover_saves_generation(tmp_path):

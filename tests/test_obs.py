@@ -142,7 +142,9 @@ def test_validate_rejects_unknowns_and_type_drift():
     assert validate_event({**ok, "v": 3}) == []             # v3 superset
     assert validate_event({**ok, "v": 4}) == []             # v4 superset
     assert validate_event({**ok, "v": 5}) == []             # v5 superset
-    assert validate_event({**ok, "v": 6})                   # future version
+    assert validate_event({**ok, "v": 6}) == []             # v6 superset
+    assert validate_event({**ok, "v": 7}) == []             # v7 superset
+    assert validate_event({**ok, "v": 8})                   # future version
     assert validate_event({"v": 1, "event": "level_end", "ts": 0.0,
                            "level": 3})                     # missing field
 
@@ -196,6 +198,78 @@ def test_validate_v5_hostdedup_segment_field():
                         for e in errs)
     assert validate_event({**seg, "flush_backlog": 0.5})  # type drift
     assert validate_event({**seg, "flush_backlog": True})  # bool ≠ int
+
+
+def test_validate_v7_pool_supervision_events():
+    """The serve worker-pool lifecycle (worker_spawn / worker_lost /
+    job_retry / quarantine) exists only from schema v7 — event-type
+    gated exactly like the v2 campaign-supervisor types, so a v6
+    consumer never sees them."""
+    spawn = {"v": 7, "event": "worker_spawn", "ts": 0.0, "worker": "w0",
+             "pid": 1234}
+    assert validate_event(spawn) == []
+    assert validate_event({**spawn, "jobs": ["a", "b"], "bins": 1,
+                           "chunk": 256, "respawn": True,
+                           "attempt": 2}) == []
+    errs = validate_event({**spawn, "v": 6})  # v7-only type on a v6 line
+    assert errs and all("requires schema version >= 7" in e for e in errs)
+    assert validate_event({**spawn, "chunk": "256"})      # type drift
+    assert validate_event({"v": 7, "event": "worker_spawn", "ts": 0.0,
+                           "worker": "w0"})               # missing pid
+
+    lost = {"v": 7, "event": "worker_lost", "ts": 0.0, "worker": "w0",
+            "kind": "killed"}
+    assert validate_event(lost) == []
+    assert validate_event({**lost, "pid": 9, "exit_code": -9,
+                           "jobs": ["a"], "detail": "signal 9"}) == []
+    assert validate_event({**lost, "v": 1})
+    assert validate_event({"v": 7, "event": "worker_lost", "ts": 0.0,
+                           "worker": "w0"})               # missing kind
+
+    retry = {"v": 7, "event": "job_retry", "ts": 0.0, "job_id": "a",
+             "attempt": 1}
+    assert validate_event(retry) == []
+    assert validate_event({**retry, "worker": "w1", "backoff_s": 0.7,
+                           "reason": "killed"}) == []
+    assert validate_event({**retry, "attempt": True})     # bool ≠ int
+
+    quar = {"v": 7, "event": "quarantine", "ts": 0.0, "job_id": "a",
+            "reason": "poison-job"}
+    assert validate_event(quar) == []
+    assert validate_event({**quar, "deaths": 3, "worker": "w2",
+                           "detail": "killed its worker 3x"}) == []
+    assert validate_event({**quar, "v": 6})
+    assert validate_event({**quar, "surprise": 1})        # unknown field
+
+
+def test_monitor_pool_attribution_rows(tmp_path):
+    """A pool.events supervision log (no segments at all) renders a
+    pool-lifecycle heartbeat; a tenant log with pool events alongside
+    segments gets the pool row appended."""
+    from raft_tla_tpu.obs.monitor import heartbeat, load_stream, summarize
+
+    p = str(tmp_path / "pool.events")
+    append_event(p, "worker_spawn", worker="w0", pid=11,
+                 jobs=["a", "b"], chunk=256)
+    append_event(p, "worker_lost", worker="w0", kind="killed",
+                 exit_code=-9, jobs=["b"])
+    append_event(p, "job_retry", job_id="b", attempt=1, worker="w1",
+                 backoff_s=0.4)
+    append_event(p, "worker_spawn", worker="w1", pid=12, respawn=True)
+    append_event(p, "quarantine", job_id="b", reason="poison-job",
+                 deaths=3)
+    s = summarize(load_stream(p))
+    assert s["pool_only"] and s["pool"]["spawns"] == 2
+    assert s["pool"]["losses"] == 1 and s["pool"]["retries"] == 1
+    assert s["pool"]["last_loss_kind"] == "killed"
+    assert s["pool"]["quarantined"] == ["b"]
+    line = heartbeat(s)
+    assert "2 spawn(s)" in line and "1 lost" in line
+    assert "last loss: killed" in line and "QUARANTINED b" in line
+    # an empty/eventless stream still reports "no segments yet"
+    q = str(tmp_path / "empty.events")
+    open(q, "w").close()
+    assert heartbeat(summarize(load_stream(q))) == "obs: no segments yet"
 
 
 def test_append_event_validates(tmp_path):

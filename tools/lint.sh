@@ -86,6 +86,16 @@ assert rec["status"] == "completed" and rec["n_states"] == 524, rec
 print("serve daemon smoke ok: watch intake served, SIGINT drained clean")
 PY
 
+echo "== serve-chaos smoke (worker pool + mid-dispatch SIGKILL, CPU) =="
+# The pool's acceptance bar in miniature: solo reference pass, then the
+# supervised worker pool with the first worker SIGKILLed after 2 segment
+# events — requeued jobs re-run losslessly and every final results
+# record and tenant event log must be canonically identical to solo.
+python -m raft_tla_tpu.serve.chaos "$SERVE_TMP/toy.cfg" \
+    --workdir "$SERVE_TMP/serve-chaos" --jobs 4 --workers 2 \
+    --chunk 256 --max-msgs 1 --kill-after-segments 2 --cpu --quiet \
+    | tail -1
+
 echo "== frontend smoke (two-phase commit through the spec compiler, CPU) =="
 cat > "$SERVE_TMP/2pc.cfg" <<'CFG'
 SPECIFICATION Spec
