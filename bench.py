@@ -129,6 +129,9 @@ def run_fiducial() -> None:
     os.environ["RAFT_TLA_MEGAKERNEL"] = "off"
     os.environ["RAFT_TLA_HOSTDEDUP"] = "off"
     os.environ["RAFT_TLA_PREFETCH"] = "off"
+    # trace_emit_overhead_us pins the DISABLED path (the default every
+    # untraced run pays) — tracing must be off in this child.
+    os.environ["RAFT_TLA_TRACE"] = "off"
     # the compile_wall_ms probe must measure a REAL XLA build: a warm
     # persistent compilation cache (serve/sched.enable_compile_cache,
     # RAFT_TLA_COMPILE_CACHE) would turn it into a disk-read fiducial.
@@ -247,6 +250,24 @@ def run_fiducial() -> None:
         fs.close()
     store_read_mb_s = _NB * _BROWS * _W * 4 / (1 << 20) / dt_r
 
+    # -- pinned trace off-path cost ----------------------------------------
+    # What every instrumentation site pays when tracing is OFF (the
+    # default): a NULL_TRACER.span() context entry/exit — one shared
+    # stateless handle, no allocation, no clock read.  Pinned so a
+    # regression in the null path (the cost every untraced run pays at
+    # every phase boundary) is code-attributable.  EXCLUDED from the
+    # campaign drift ratio (supervisor._DRIFT_EXEMPT): sub-µs walls are
+    # scheduler-hiccup noise at ratio scale.
+    from raft_tla_tpu.obs.trace import NULL_TRACER
+    _TRACE_ITERS = 200_000
+    with NULL_TRACER.span("warm"):
+        pass
+    t_n = time.monotonic()
+    for _ in range(_TRACE_ITERS):
+        with NULL_TRACER.span("fiducial"):
+            pass
+    trace_emit_us = (time.monotonic() - t_n) * 1e6 / _TRACE_ITERS
+
     print(json.dumps({
         "copy_512mb_ms": round(copy_ms, 2),
         "compile_wall_ms": round(compile_ms, 1),
@@ -256,6 +277,7 @@ def run_fiducial() -> None:
                               2),
         "flush_keys_per_sec": round(flush_keys_per_sec, 1),
         "store_read_mb_s": round(store_read_mb_s, 1),
+        "trace_emit_overhead_us": round(trace_emit_us, 4),
     }))
 
 
@@ -514,12 +536,17 @@ def main() -> None:
     events_path = os.environ.get("RAFT_TLA_EVENTS")
     if events_path:
         # chip-weather evidence into the campaign's event log: the
-        # monitor reads fiducials off run_start events to report drift
+        # monitor reads fiducials off run_start events to report drift;
+        # the anchor/host pair (schema v8) additionally makes the bench
+        # log clock-alignable in a raft-tla-trace collection, so chip
+        # weather can be read against a traced run's timeline.
         try:
             from raft_tla_tpu.obs.events import append_event, git_sha
+            from raft_tla_tpu.obs.trace import clock_anchor, host_context
             append_event(events_path, "run_start", engine="bench",
                          universe={}, spec="fiducial", invariants=[],
                          resumed=False, fiducials=fid,
+                         anchor=clock_anchor(), host=host_context(),
                          **({"git_sha": git_sha()} if git_sha() else {}))
         except Exception as e:      # evidence channel, never the verdict
             print(f"bench: event append failed: {e!r}", file=sys.stderr)

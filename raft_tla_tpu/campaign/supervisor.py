@@ -179,6 +179,14 @@ class _LogTail:
         return out
 
 
+# Fiducials excluded from the drift verdict: sub-microsecond timing
+# pins (the trace off-path cost) are too noisy for a ratio test — a
+# scheduler hiccup would read as 3x "drift" on a number measured in
+# tenths of a microsecond.  They are pinned for the A/B record, not as
+# a health signal.
+_DRIFT_EXEMPT = frozenset({"trace_emit_overhead_us"})
+
+
 def _median(xs: list) -> float:
     s = sorted(xs)
     n = len(s)
@@ -270,7 +278,7 @@ class HealthMonitor:
         base, cur = self.fiducial_baseline, self.fiducials_seen
         if not self.policy.drift_max or not base or not cur:
             return None
-        for key in sorted(set(base) & set(cur)):
+        for key in sorted(set(base) & set(cur) - _DRIFT_EXEMPT):
             a, b = base[key], cur[key]
             if isinstance(a, (int, float)) and isinstance(b, (int, float)) \
                     and a > 0 and b / a > self.policy.drift_max:
@@ -356,6 +364,18 @@ class Supervisor:
             self.policy.backoff_base_s, self.policy.backoff_cap_s,
             seed=self.policy.backoff_jitter_seed)
         self._last_backoff_s = 0.0
+        # v8 tracing (RAFT_TLA_TRACE, inherited by the child): child
+        # attempt lifetimes and preempt->exit drains become spans in
+        # supervisor.events; the anchored run_start puts the supervisor
+        # on the same wall axis as the child's engine spans.  Gated so
+        # untraced supervisor logs stay byte-compatible with v2 readers.
+        from raft_tla_tpu.obs.trace import (NULL_TRACER,
+                                            anchored_run_start,
+                                            trace_enabled, tracer_for)
+        self.tracer = NULL_TRACER
+        if trace_enabled():
+            anchored_run_start(self.sup_events, "campaign")
+            self.tracer = tracer_for(self.sup_events)
 
     # ---------------------------------------------------------------- util
 
@@ -620,6 +640,8 @@ class Supervisor:
         with open(out_path, "ab") as out:
             proc = subprocess.Popen(argv, stdout=out,
                                     stderr=subprocess.STDOUT)
+        t0_mono = time.monotonic()       # attempt span start (tracing)
+        drain_mono = None                # preempt signal sent (drain span)
         hm.spawned_at = self.clock()
         self._say(f"attempt {attempt}: pid {proc.pid}, ndev {ndev}, "
                   + ("resume" if resume else "fresh start"))
@@ -641,6 +663,7 @@ class Supervisor:
                 if bad:
                     self._preempt(proc, bad[0], bad[1], hm)
                     preempted_at = self.clock()
+                    drain_mono = time.monotonic()
             elif not killed and \
                     self.clock() - preempted_at > self.policy.grace_s:
                 self._say(f"grace window ({self.policy.grace_s:.0f}s) "
@@ -652,6 +675,19 @@ class Supervisor:
                 killed = True
             self.sleep(self.policy.poll_s)
         events.extend(tail.poll())       # drain the post-exit flush
+        if self.tracer.enabled:
+            now_mono = time.monotonic()
+            self.tracer.emit_span(
+                "attempt", t0_mono, now_mono - t0_mono,
+                thread="children", attempt=attempt, pid=proc.pid,
+                ndev=ndev, exit_code=rc,
+                preempted=preempted_at is not None)
+            if drain_mono is not None:
+                # preempt-signal -> child-exit: the lossless-stop drain
+                # (SIGKILL included when the grace window expired).
+                self.tracer.emit_span(
+                    "preempt_drain", drain_mono, now_mono - drain_mono,
+                    thread="children", attempt=attempt, killed=killed)
         if hm.fiducials_seen and not self._state.get("fiducials"):
             self._save_state(fiducials=hm.fiducials_seen)
         return rc, events, preempted_at is not None

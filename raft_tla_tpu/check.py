@@ -279,6 +279,15 @@ def build_argparser() -> argparse.ArgumentParser:
                         "runs of the same bounds skip XLA compilation "
                         "entirely — the serve daemon's warm-start knob, "
                         "useful for single checks too")
+    p.add_argument("--trace", action="store_true",
+                   help="emit schema-v8 span events (trace spans with "
+                        "nesting and thread attribution) into the "
+                        "--events log; merge and export with "
+                        "raft-tla-trace. Unlike --phase-timers this adds "
+                        "no device syncs — spans record host-side "
+                        "dispatch walls. Distinct from --no-trace, which "
+                        "suppresses counterexample trace RENDERING. Also "
+                        "RAFT_TLA_TRACE=1")
     p.add_argument("--phase-timers", action="store_true",
                    help="attribute wall time to search phases (upload/"
                         "expand/export/dedup/snapshot, plus dedup_submit/"
@@ -680,20 +689,29 @@ def main(argv=None) -> int:
     if args.stats and args.engine not in _DEVICE_ENGINES:
         p.error(f"--stats requires a device-class engine "
                 f"(got {args.engine})")
-    if (args.events or args.phase_timers) and \
+    if (args.events or args.phase_timers or args.trace) and \
             args.engine not in _DEVICE_ENGINES:
-        p.error(f"--events/--phase-timers require a device-class engine "
-                f"(got {args.engine}); other engines emit no run events")
-    if args.events or args.phase_timers:
+        p.error(f"--events/--phase-timers/--trace require a device-class "
+                f"engine (got {args.engine}); other engines emit no run "
+                "events")
+    if args.trace and not (args.events or os.environ.get(
+            "RAFT_TLA_EVENTS")):
+        p.error("--trace requires --events PATH (spans are rows in the "
+                "run-event log; without a log there is nowhere to put "
+                "them)")
+    if args.events or args.phase_timers or args.trace:
         # Process-wide, like --sig-prune: every engine an invocation
         # builds (including liveness re-runs) reads the same env gate.
         import os
         from raft_tla_tpu.obs.events import ENV_EVENTS
         from raft_tla_tpu.obs.phases import ENV_PHASE_TIMERS
+        from raft_tla_tpu.obs.trace import ENV_TRACE
         if args.events:
             os.environ[ENV_EVENTS] = args.events
         if args.phase_timers:
             os.environ[ENV_PHASE_TIMERS] = "1"
+        if args.trace:
+            os.environ[ENV_TRACE] = "1"
     try:
         config, props = _resolve_config(args)
     except (OSError, ValueError) as e:

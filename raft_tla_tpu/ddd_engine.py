@@ -1177,7 +1177,8 @@ class DDDEngine:
         # checkpoint, level boundary, terminal/stop paths) drains first.
         worker = flushq.DedupWorker(
             lambda batch: self._flush(batch, master, host, constore,
-                                      keystore, cov)) \
+                                      keystore, cov),
+            phases=tel.phases) \
             if self._host_dedup else None
         if worker is not None:
             _cleanup.callback(worker.close)
@@ -1230,7 +1231,8 @@ class DDDEngine:
                 return jax.block_until_ready(
                     (jax.device_put(rb), jax.device_put(cb)))
 
-            prefetcher = prefetch.BlockPrefetcher(pf_load)
+            prefetcher = prefetch.BlockPrefetcher(
+                pf_load, phases=tel.phases, tracer=tel.trace)
             _cleanup.callback(prefetcher.close)
         viol = None          # (kind, inv_idx, dead_g) once detected
         viol_key = None

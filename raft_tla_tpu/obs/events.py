@@ -25,7 +25,7 @@ wall).  This module replaces all of that with:
   ``level_end`` derived automatically from level transitions and
   ``violation`` derived from the final :class:`~raft_tla_tpu.engine.EngineResult`.
 
-Event grammar (``SCHEMA_VERSION`` = 6; earlier-version lines remain
+Event grammar (``SCHEMA_VERSION`` = 8; earlier-version lines remain
 valid) —
 every line is one JSON object with base fields ``v`` (schema version),
 ``event`` (type) and ``ts`` (unix epoch seconds):
@@ -109,12 +109,30 @@ event types invalid on a ``"v" < 7`` line:
                    (poison verdict: the job killed its worker K times
                     and will never be executed again)
 
+Version 8 adds the cross-process tracing layer (obs/trace.py — gated by
+``--trace`` / ``RAFT_TLA_TRACE``, never on by default):
+
+``span``           name, span_id, t0, dur, thread [+ parent_id, args]
+                   (one completed traced region: ``t0`` is
+                    ``time.monotonic()`` in the emitting process and
+                    ``dur`` seconds; ``thread`` the emitting thread's
+                    name or a synthetic track like ``"tickets"``;
+                    ``parent_id`` nests spans per thread)
+``run_start.anchor``  wall/mono/err_s clock-anchor pair — the emitting
+                   process's ``time.time()`` read bracketed by two
+                   ``time.monotonic()`` reads, so the trace collector
+                   (obs/collect.py) can place monotonic span timestamps
+                   from many processes on one wall axis with a recorded
+                   error bound
+``run_start.host`` host context for cross-session comparison (nproc,
+                   jax version, backend)
+
 A run log with no ``run_end`` means the process died — crash attribution
 for free.  The schema is strict: unknown fields fail validation and the
-v2/v7-only event types (resp. v3/v4/v5/v6-only fields) are invalid on a
-``"v" < 2`` / ``"v" < 7`` (resp. ``"v" < 3`` / ``"v" < 4`` / ``"v" < 5``
-/ ``"v" < 6``) line, so any addition requires a version bump (versioning
-policy in README.md).
+v2/v7/v8-only event types (resp. v3/v4/v5/v6/v8-only fields) are invalid
+on a ``"v" < 2`` / ``"v" < 7`` / ``"v" < 8`` (resp. ``"v" < 3`` /
+``"v" < 4`` / ``"v" < 5`` / ``"v" < 6`` / ``"v" < 8``) line, so any
+addition requires a version bump (versioning policy in README.md).
 """
 
 from __future__ import annotations
@@ -127,8 +145,8 @@ import subprocess
 import threading
 import time
 
-SCHEMA_VERSION = 7
-_VERSIONS = (1, 2, 3, 4, 5, 6, 7)  # versions validate_event accepts
+SCHEMA_VERSION = 8
+_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)  # versions validate_event accepts
 
 # Environment knobs (set by check.py --events/--phase-timers; inherited by
 # liveness re-runs and bench children the same way RAFT_TLA_SIGPRUNE is).
@@ -186,6 +204,8 @@ _REQUIRED = {
     "worker_lost": {"worker": str, "kind": str},
     "job_retry": {"job_id": str, "attempt": int},
     "quarantine": {"job_id": str, "reason": str},
+    "span": {"name": str, "span_id": int, "t0": _NUM, "dur": _NUM,
+             "thread": str},
 }
 
 # Event types that only exist from schema version 2 on (the campaign
@@ -196,6 +216,10 @@ _V2_EVENTS = frozenset({"preempt", "reshard", "resume_attempt"})
 # worker-pool supervision lifecycle) — invalid on a "v" < 7 line.
 _V7_EVENTS = frozenset({"worker_spawn", "worker_lost", "job_retry",
                         "quarantine"})
+
+# Event types that only exist from schema version 8 on (the cross-process
+# tracing layer, obs/trace.py) — invalid on a "v" < 8 line.
+_V8_EVENTS = frozenset({"span"})
 
 # Fields that only exist from schema version 3 on (walker-fleet
 # statistical checking) — invalid on a "v" < 3 line.
@@ -214,11 +238,15 @@ _V5_FIELDS = {"segment": frozenset({"flush_backlog"})}
 # attribution) — invalid on a "v" < 6 line.
 _V6_FIELDS = {"segment": frozenset({"upload_wait_ms", "prefetch_hits"})}
 
+# Fields that only exist from schema version 8 on (trace clock anchors
+# and host context) — invalid on a "v" < 8 line.
+_V8_FIELDS = {"run_start": frozenset({"anchor", "host"})}
+
 _OPTIONAL = {
     "run_start": {"bounds": dict, "symmetry": list, "view": str,
                   "chunk": int, "caps": str, "n_states": int,
                   "n_devices": int, "git_sha": str, "fiducials": dict,
-                  "pid": int},
+                  "pid": int, "anchor": dict, "host": dict},
     "segment": {"coverage": dict, "route_peak": int, "n_devices": int,
                 "inv_evals": dict, "phase_s": dict, "device_rates": list,
                 "bin": str, "inflight": int, "flush_backlog": int,
@@ -240,6 +268,7 @@ _OPTIONAL = {
                     "detail": str},
     "job_retry": {"worker": str, "backoff_s": _NUM, "reason": str},
     "quarantine": {"deaths": int, "worker": str, "detail": str},
+    "span": {"parent_id": int, "args": dict},
 }
 
 
@@ -269,6 +298,8 @@ def validate_event(d: dict) -> list:
         errs.append(f"{ev}: event type requires schema version >= 2")
     if ev in _V7_EVENTS and d["v"] in _VERSIONS and d["v"] < 7:
         errs.append(f"{ev}: event type requires schema version >= 7")
+    if ev in _V8_EVENTS and d["v"] in _VERSIONS and d["v"] < 8:
+        errs.append(f"{ev}: event type requires schema version >= 8")
     req, opt = _REQUIRED[ev], _OPTIONAL[ev]
     for k, spec in req.items():
         if k not in d:
@@ -279,6 +310,7 @@ def validate_event(d: dict) -> list:
     v4_only = _V4_FIELDS.get(ev, frozenset())
     v5_only = _V5_FIELDS.get(ev, frozenset())
     v6_only = _V6_FIELDS.get(ev, frozenset())
+    v8_only = _V8_FIELDS.get(ev, frozenset())
     for k, val in d.items():
         if k in _BASE or k in req:
             continue
@@ -295,6 +327,8 @@ def validate_event(d: dict) -> list:
             errs.append(f"{ev}: field {k!r} requires schema version >= 5")
         elif k in v6_only and d["v"] in _VERSIONS and d["v"] < 6:
             errs.append(f"{ev}: field {k!r} requires schema version >= 6")
+        elif k in v8_only and d["v"] in _VERSIONS and d["v"] < 8:
+            errs.append(f"{ev}: field {k!r} requires schema version >= 8")
     return errs
 
 
@@ -541,6 +575,8 @@ class RunTelemetry:
                  resumed: bool = False, n0: int | None = 1,
                  n_devices: int | None = None, t0: float | None = None):
         from raft_tla_tpu.obs.phases import PhaseTimers
+        from raft_tla_tpu.obs.trace import (NULL_TRACER, SpanTracer,
+                                            trace_enabled)
         self.engine = engine
         self.config = config
         self.caps = caps
@@ -548,7 +584,13 @@ class RunTelemetry:
         self.resumed = resumed
         path = events or os.environ.get(ENV_EVENTS) or None
         self.log = EventLog(path) if path else None
+        # Spans need a sink: tracing stays NULL (the off path) without a
+        # log even when the gate is on, preserving `active`'s contract.
+        self.trace = (SpanTracer(self.log.emit)
+                      if self.log is not None and trace_enabled()
+                      else NULL_TRACER)
         self.phases = PhaseTimers.from_env()
+        self.phases.tracer = self.trace
         inv = tuple(config.invariants) if config is not None else ()
         self.tracker = ProgressTracker(
             t0 if t0 is not None else time.monotonic(),
@@ -602,6 +644,13 @@ class RunTelemetry:
         if fiducials:
             fields["fiducials"] = fiducials
         fields["pid"] = os.getpid()
+        # The v8 clock anchor: always stamped (cheap, three clock reads)
+        # so any log joins a merged trace timeline; host context rides
+        # along only when tracing, where cross-host comparison matters.
+        from raft_tla_tpu.obs.trace import clock_anchor, host_context
+        fields["anchor"] = clock_anchor()
+        if self.trace.enabled:
+            fields["host"] = host_context()
         self.log.emit("run_start", **fields)
 
     def segment(self, n_states: int, level: int, n_transitions: int,

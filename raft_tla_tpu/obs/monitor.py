@@ -1,5 +1,9 @@
 """Campaign monitor — read a run-event log and render a one-line
-heartbeat (``raft-tla-monitor``).
+heartbeat (``raft-tla-monitor``).  Point it at a DIRECTORY instead and
+it renders the combined fleet view: one heartbeat per ``*.events`` log
+found (obs/collect.find_logs does the sweep) plus the aggregate row —
+summed incremental rate over live tenants, live/ended/crashed counts,
+and the merged pool worker attribution.
 
 The reader is the ONE place that knows how to turn an on-disk stream
 into a clean timeline; ``runs/campaign_projection.py`` is a thin client
@@ -369,6 +373,74 @@ def heartbeat(summary: dict | None) -> str:
 
 
 # --------------------------------------------------------------------------
+# fleet view (directory mode)
+
+
+def fleet_view(root: str, window_s: float = 600.0,
+               stale_after_s: float | None = None) -> tuple:
+    """Summarize every ``*.events`` log under ``root`` (the collector's
+    sweep — obs/collect.find_logs).  Returns ``(rows, totals)``: one
+    ``(relpath, summary)`` per readable log, and the fleet aggregate —
+    summed incremental rate and state count over live tenant timelines,
+    live/ended/crashed attribution counts, and the merged pool counters
+    (spawns/losses/retries/quarantines across supervision logs)."""
+    import os
+
+    from raft_tla_tpu.obs.collect import find_logs
+
+    rows = []
+    for path in find_logs(root):
+        try:
+            stream = load_stream(path)
+        except OSError:
+            continue
+        rows.append((os.path.relpath(path, root),
+                     summarize(stream, window_s=window_s,
+                               stale_after_s=stale_after_s)))
+    totals = {"n_logs": len(rows), "inc_states_per_sec": 0.0,
+              "n_states": 0, "live": 0, "ended": 0, "crashed": 0,
+              "pool": {"spawns": 0, "losses": 0, "retries": 0,
+                       "quarantined": []}}
+    pooled = False
+    for _name, s in rows:
+        if s is None:
+            continue
+        if s.get("pool"):
+            pooled = True
+            for k in ("spawns", "losses", "retries"):
+                totals["pool"][k] += s["pool"][k]
+            totals["pool"]["quarantined"].extend(s["pool"]["quarantined"])
+        if s.get("pool_only"):
+            continue
+        totals["n_states"] += s["n_states"]
+        status = s["status"]
+        if status.startswith("live"):
+            totals["live"] += 1
+            totals["inc_states_per_sec"] += s["inc_states_per_sec"]
+        elif status.startswith("presumed-crashed"):
+            totals["crashed"] += 1
+        else:
+            totals["ended"] += 1
+    if not pooled:
+        totals["pool"] = None
+    return rows, totals
+
+
+def _fleet_lines(rows: list, totals: dict) -> str:
+    width = max((len(n) for n, _s in rows), default=0)
+    lines = [f"{name:<{width}}  {heartbeat(s)}" for name, s in rows]
+    agg = [f"fleet: {totals['n_logs']} log(s)",
+           f"{totals['n_states']:,} st",
+           f"inc {totals['inc_states_per_sec']:,.0f}/s",
+           f"{totals['live']} live / {totals['ended']} ended / "
+           f"{totals['crashed']} presumed-crashed"]
+    if totals["pool"]:
+        agg.append(_fmt_pool(totals["pool"]))
+    lines.append(" | ".join(agg))
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
 # CLI
 
 
@@ -377,7 +449,12 @@ def main(argv=None) -> int:
         prog="raft-tla-monitor",
         description="One-line heartbeat over a run-event log "
                     "(or legacy .stats stream).")
-    p.add_argument("path", help="event log (JSONL) to read")
+    p.add_argument("path",
+                   help="event log (JSONL) to read — or a DIRECTORY, "
+                        "which is swept recursively for *.events and "
+                        "rendered as a combined fleet view (one "
+                        "heartbeat per log + the summed incremental "
+                        "rate and pool attribution)")
     p.add_argument("--follow", action="store_true",
                    help="re-read and re-print every --interval seconds")
     p.add_argument("--interval", type=float, default=10.0)
@@ -394,7 +471,22 @@ def main(argv=None) -> int:
                    help="print the full summary as JSON instead")
     args = p.parse_args(argv)
 
+    import os
     while True:
+        if os.path.isdir(args.path):
+            rows, totals = fleet_view(args.path, window_s=args.window,
+                                      stale_after_s=args.stale_after)
+            if args.json:
+                print(json.dumps({"logs": dict(rows), "fleet": totals},
+                                 default=str), flush=True)
+            elif not rows:
+                print(f"obs: no *.events under {args.path}", flush=True)
+            else:
+                print(_fleet_lines(rows, totals), flush=True)
+            if not args.follow:
+                return 0 if rows else 1
+            time.sleep(args.interval)
+            continue
         try:
             stream = load_stream(args.path)
         except FileNotFoundError:

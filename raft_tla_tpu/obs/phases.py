@@ -38,13 +38,30 @@ phase-timer signature), and ``upload`` becomes the wait for an
 already-staged buffer (a prefetch *hit* costs a swap; a *miss* pays
 the old read+pad+h2d inline).
 
-This module is host-path orchestration only — nothing here is ever
-traced (the no-op handle is what jit-adjacent code touches).
+**Thread attribution** (schema v8): phases recorded on a thread other
+than the one that built the ``PhaseTimers`` accumulate under
+``{name}@{thread-name}`` — a background flush shows up as
+``dedup@raft-tla-flush``, never silently folded into (or racing with)
+the main thread's bucket.  Accumulation is lock-protected so background
+workers (flushq, prefetch) can time their own work.
+
+**Span integration**: when a :class:`~raft_tla_tpu.obs.trace.SpanTracer`
+is attached (``timers.tracer``, wired by ``RunTelemetry``), every
+enabled phase handle also emits one v8 ``span`` event at exit — the same
+named region lands in both the per-segment ``phase_s`` aggregate and the
+merged trace timeline.  With tracing on but timers off the handle skips
+``sync`` (no ``block_until_ready``), so spans record honest *host-side*
+walls — dispatch time, not device time — and the engine pipelining the
+timers would serialise stays intact.
+
+This module is host-path orchestration only — nothing here runs under
+jit (the no-op handle is what jit-adjacent code touches).
 """
 
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 ENV_PHASE_TIMERS = "RAFT_TLA_PHASE_TIMERS"
@@ -71,14 +88,18 @@ _NULL = _NullPhase()
 class _Phase:
     """An enabled timed region; ``sync(x)`` marks x to block on at exit."""
 
-    __slots__ = ("_timers", "_name", "_t0", "_pending")
+    __slots__ = ("_timers", "_name", "_t0", "_pending", "_span")
 
     def __init__(self, timers: "PhaseTimers", name: str):
         self._timers = timers
         self._name = name
         self._pending = None
+        self._span = None
 
     def __enter__(self):
+        tr = self._timers.tracer
+        if tr is not None and tr.enabled:
+            self._span = tr.span(self._name).__enter__()
         self._t0 = time.monotonic()
         return self
 
@@ -87,22 +108,42 @@ class _Phase:
         return value
 
     def __exit__(self, *exc):
-        if self._pending is not None:
+        timers = self._timers
+        if timers.enabled and self._pending is not None:
             import jax  # host path; deferred so obs imports stay light
             jax.block_until_ready(self._pending)
-            self._pending = None
-        acc = self._timers._acc
-        acc[self._name] = acc.get(self._name, 0.0) + (
-            time.monotonic() - self._t0)
+        self._pending = None
+        if timers.enabled:
+            dur = time.monotonic() - self._t0
+            name = self._name
+            if threading.get_ident() != timers._owner:
+                # Explicit background-thread attribution: never race
+                # with (or masquerade as) the owning thread's bucket.
+                name = f"{name}@{threading.current_thread().name}"
+            with timers._lock:
+                acc = timers._acc
+                acc[name] = acc.get(name, 0.0) + dur
+        if self._span is not None:
+            # Close after the sync so a timed phase's span covers the
+            # same (device-honest) wall the phase_s bucket records.
+            self._span.__exit__()
         return False
 
 
 class PhaseTimers:
-    """Per-phase wall-time accumulator; disabled unless asked for."""
+    """Per-phase wall-time accumulator; disabled unless asked for.
+
+    ``tracer`` (attached by ``RunTelemetry``) piggybacks v8 trace spans
+    on the same phase sites: the handle is live when *either* layer is
+    on, but syncs (and accumulates) only when the timers are.
+    """
 
     def __init__(self, enabled: bool = False):
         self.enabled = enabled
+        self.tracer = None               # SpanTracer | None (NULL ok)
         self._acc: dict = {}
+        self._lock = threading.Lock()
+        self._owner = threading.get_ident()
 
     @classmethod
     def from_env(cls) -> "PhaseTimers":
@@ -111,14 +152,17 @@ class PhaseTimers:
 
     def phase(self, name: str):
         if not self.enabled:
-            return _NULL
+            tr = self.tracer
+            if tr is None or not tr.enabled:
+                return _NULL
         return _Phase(self, name)
 
     def snapshot(self, reset: bool = True) -> dict:
         """Drain accumulated per-phase walls (rounded; {} when disabled)."""
-        if not self._acc:
-            return {}
-        out = {k: round(v, 4) for k, v in sorted(self._acc.items())}
-        if reset:
-            self._acc = {}
+        with self._lock:
+            if not self._acc:
+                return {}
+            out = {k: round(v, 4) for k, v in sorted(self._acc.items())}
+            if reset:
+                self._acc = {}
         return out

@@ -792,7 +792,8 @@ class DDDShardEngine:
                 jax.block_until_ready(out[:4])
                 return out
 
-            prefetcher = prefetch.BlockPrefetcher(pf_load)
+            prefetcher = prefetch.BlockPrefetcher(
+                pf_load, phases=tel.phases, tracer=tel.trace)
             _cleanup.callback(prefetcher.close)
         OCAP = self.caps.seg_rows
         fail = 0

@@ -347,12 +347,14 @@ class BatchExecutor:
     """
 
     def __init__(self, chunk: int = 1024, max_states: int | None = None,
-                 depth: int = 2, compile_async: bool = True, stop=None):
+                 depth: int = 2, compile_async: bool = True, stop=None,
+                 tracer=None):
         self.chunk = chunk
         self.max_states = max_states
         self.depth = depth
         self.compile_async = compile_async
         self.stop = stop
+        self.tracer = tracer            # SpanTracer | None (v8 tracing)
         self.last_stats: dict | None = None   # scheduler stats of last run
 
     def run(self, jobs, telemetry: dict | None = None,
@@ -393,7 +395,7 @@ class BatchExecutor:
         sched = DispatchScheduler(
             chunk=self.chunk, max_states=self.max_states,
             depth=self.depth, compile_async=self.compile_async,
-            stop=self.stop)
+            stop=self.stop, tracer=self.tracer)
         try:
             self.last_stats = sched.run(bins, outcomes)
             # The scheduler returns with live lanes only when stopped

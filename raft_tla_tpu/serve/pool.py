@@ -101,6 +101,8 @@ class _Worker:
         self.signaled_at: float | None = None
         self.killed = False
         self.draining = False
+        self.t0_mono = time.monotonic()     # lifetime span start (tracing)
+        self.signal_mono: float | None = None  # SIGINT sent (drain span)
 
     def out_tail(self, n: int = 4096) -> str:
         try:
@@ -201,6 +203,16 @@ def run_pool(jobs, out_dir: str, *, workers: int = 2, chunk: int = 1024,
     pool_dir = os.path.join(out_dir, "pool")
     os.makedirs(pool_dir, exist_ok=True)
     pool_events = os.path.join(out_dir, "pool.events")
+    # v8 tracing: worker lifetimes and SIGINT->exit drains become spans
+    # in pool.events, and the anchored run_start lets the collector put
+    # the supervisor on the same wall axis as its children.  Gated so an
+    # untraced pool log is byte-compatible with v7 consumers.
+    from raft_tla_tpu.obs.trace import NULL_TRACER, anchored_run_start, \
+        trace_enabled, tracer_for
+    tracer = NULL_TRACER
+    if trace_enabled():
+        anchored_run_start(pool_events, "pool")
+        tracer = tracer_for(pool_events)
 
     def say(msg: str) -> None:
         if not quiet:
@@ -410,6 +422,17 @@ def run_pool(jobs, out_dir: str, *, workers: int = 2, chunk: int = 1024,
 
     def reap(w: _Worker, rc: int) -> None:
         active.remove(w)
+        if tracer.enabled:
+            now_mono = time.monotonic()
+            tracer.emit_span("worker", w.t0_mono, now_mono - w.t0_mono,
+                             thread="workers", worker=w.wid,
+                             pid=w.proc.pid, exit_code=rc)
+            if w.signal_mono is not None:
+                # SIGINT->exit: how much of the grace window the drain
+                # actually used (nests inside the worker lifetime).
+                tracer.emit_span("drain", w.signal_mono,
+                                 now_mono - w.signal_mono,
+                                 thread="workers", worker=w.wid)
         last = refresh_done()
         unfinished = w.group.pending_jobs()
         if w.draining:
@@ -474,6 +497,7 @@ def run_pool(jobs, out_dir: str, *, workers: int = 2, chunk: int = 1024,
             for w in active:
                 w.draining = True
                 w.signaled_at = now
+                w.signal_mono = time.monotonic()
                 try:
                     w.proc.send_signal(signal.SIGINT)
                 except OSError:
@@ -498,6 +522,7 @@ def run_pool(jobs, out_dir: str, *, workers: int = 2, chunk: int = 1024,
                         reason, detail = bad
                         w.preempt = bad
                         w.signaled_at = now
+                        w.signal_mono = time.monotonic()
                         append_event(pool_events, "preempt",
                                      reason=reason, detail=detail,
                                      pid=w.proc.pid)

@@ -58,6 +58,7 @@ from collections import deque
 
 import numpy as np
 
+from raft_tla_tpu.obs.trace import NULL_TRACER
 from raft_tla_tpu.ops import fingerprint as fpr
 
 ENV_COMPILE_CACHE = "RAFT_TLA_COMPILE_CACHE"
@@ -94,13 +95,14 @@ class _Ticket:
     """One in-flight fused dispatch: the device outputs plus the host
     metadata needed to demux them per lane at harvest time."""
 
-    __slots__ = ("bn", "slices", "out", "buf_idx")
+    __slots__ = ("bn", "slices", "out", "buf_idx", "t_disp")
 
-    def __init__(self, bn, slices, out, buf_idx):
+    def __init__(self, bn, slices, out, buf_idx, t_disp=0.0):
         self.bn = bn
         self.slices = slices            # [(lane, row0, nrows, gidx)]
         self.out = out                  # device dict (async results)
         self.buf_idx = buf_idx
+        self.t_disp = t_disp            # monotonic issue time (tracing)
 
 
 class _BinState:
@@ -138,7 +140,7 @@ class DispatchScheduler:
 
     def __init__(self, chunk: int, max_states: int | None = None,
                  depth: int = 2, compile_async: bool = True,
-                 stop=None):
+                 stop=None, tracer=None):
         if depth < 1:
             raise ValueError(f"pipeline depth must be >= 1, got {depth}")
         self.chunk = chunk
@@ -146,6 +148,12 @@ class DispatchScheduler:
         self.depth = depth
         self.compile_async = compile_async
         self.stop = stop
+        # v8 tracing (``--trace``): dispatch/harvest/compile spans plus
+        # per-ticket issue->harvest lifetimes on a synthetic "tickets"
+        # track (they overlap the main thread's nested spans).  The
+        # NULL tracer's span() returns one shared no-op handle, so the
+        # untraced path stays allocation-free.
+        self.tracer = tracer or NULL_TRACER
         self.inflight: deque[_Ticket] = deque()
         self.stats = {"dispatches": 0, "peak_inflight": 0,
                       "async_compiles": 0, "compile_wall_s": {}}
@@ -158,15 +166,17 @@ class DispatchScheduler:
         back on the dispatch path but correctness is unchanged."""
         import jax
         import jax.numpy as jnp
-        t0 = time.monotonic()
-        fn = jax.jit(st.bn.step_fn)
-        try:
-            spec = jax.ShapeDtypeStruct((self.chunk, st.bn.lay.width),
-                                        jnp.int32)
-            st.compiled = fn.lower(spec).compile()
-        except Exception:
-            st.compiled = fn
-        st.compile_wall_s = time.monotonic() - t0
+        with self.tracer.span("compile",
+                              bin=getattr(st.bn, "tag", "bin")):
+            t0 = time.monotonic()
+            fn = jax.jit(st.bn.step_fn)
+            try:
+                spec = jax.ShapeDtypeStruct((self.chunk, st.bn.lay.width),
+                                            jnp.int32)
+                st.compiled = fn.lower(spec).compile()
+            except Exception:
+                st.compiled = fn
+            st.compile_wall_s = time.monotonic() - t0
 
     def _start_compile(self, st: _BinState) -> None:
         if not self.compile_async:
@@ -248,20 +258,24 @@ class DispatchScheduler:
         plan = self._plan_takes(st, live)
         if not plan:
             return False
-        buf_idx = st.free.pop(0)
-        buf = st.bufs[buf_idx]
-        B = self.chunk
-        slices, pos = [], 0
-        for lane, take in plan:
-            gidx, vecs = lane.take(take)
-            lane.inflight_slices += 1
-            buf[pos:pos + take] = vecs
-            slices.append((lane, pos, take, gidx))
-            pos += take
-        if pos < B:                      # pad to the static chunk shape
-            buf[pos:B] = buf[0]
-        out = st.compiled(jnp.asarray(buf))   # async: enqueue, don't wait
-        self.inflight.append(_Ticket(bn, slices, out, buf_idx))
+        tr = self.tracer
+        with tr.span("dispatch", bin=getattr(bn, "tag", "bin")) as sp:
+            buf_idx = st.free.pop(0)
+            buf = st.bufs[buf_idx]
+            B = self.chunk
+            slices, pos = [], 0
+            for lane, take in plan:
+                gidx, vecs = lane.take(take)
+                lane.inflight_slices += 1
+                buf[pos:pos + take] = vecs
+                slices.append((lane, pos, take, gidx))
+                pos += take
+            if pos < B:                  # pad to the static chunk shape
+                buf[pos:B] = buf[0]
+            out = st.compiled(jnp.asarray(buf))  # async: enqueue, no wait
+            sp.set(rows=pos, lanes=len(slices))
+        t_disp = time.monotonic() if tr.enabled else 0.0
+        self.inflight.append(_Ticket(bn, slices, out, buf_idx, t_disp))
         self.stats["dispatches"] += 1
         self.stats["peak_inflight"] = max(self.stats["peak_inflight"],
                                           len(self.inflight))
@@ -274,9 +288,22 @@ class DispatchScheduler:
         host phase (dedup, lane scan, gather, backfill) — verbatim the
         PR 6 ``_dispatch`` tail, minus the lanes stopped since issue
         (their speculative slices drop whole)."""
+        tk = self.inflight.popleft()
+        tr = self.tracer
+        tag = getattr(tk.bn, "tag", "bin")
+        with tr.span("harvest", bin=tag):
+            self._harvest_ticket(tk, states, outcomes)
+        if tr.enabled:
+            # The ticket's issue->harvest lifetime overlaps the main
+            # thread's nested spans, so it rides a synthetic track.
+            tr.emit_span("ticket", tk.t_disp,
+                         time.monotonic() - tk.t_disp,
+                         thread="tickets", bin=tag)
+
+    def _harvest_ticket(self, tk: _Ticket, states: dict,
+                        outcomes: dict) -> None:
         from raft_tla_tpu.serve.batch import _LaneFailure
         import jax.numpy as jnp
-        tk = self.inflight.popleft()
         bn, out = tk.bn, tk.out
         B, W, A = self.chunk, bn.lay.width, bn.A
 

@@ -220,15 +220,36 @@ def run_service(jobs, out_dir: str, chunk: int = 1024,
         say(f"serving {len(admitted)} admitted job(s) "
             f"({len(jobs) - len(admitted)} rejected) — chunk {chunk}, "
             f"pipeline depth {depth}")
+        # Scheduler-level spans (dispatch/harvest/compile/ticket) are
+        # cross-lane, so they get their own per-process log — pid-keyed
+        # because pool workers share one out_dir.  The collector merges
+        # it with the tenant logs of the same pid into one track set.
+        from raft_tla_tpu.obs import EventLog
+        from raft_tla_tpu.obs.trace import (SpanTracer, clock_anchor,
+                                            host_context, trace_enabled)
+        tracer = None
+        sched_log = None
+        if trace_enabled():
+            sched_log = EventLog(os.path.join(
+                out_dir, f"sched-{os.getpid()}.events"))
+            sched_log.emit("run_start", engine="sched", universe={},
+                           spec="", invariants=[], resumed=False,
+                           pid=os.getpid(), anchor=clock_anchor(),
+                           host=host_context())
+            tracer = SpanTracer(sched_log.emit)
         ex = BatchExecutor(chunk=chunk, max_states=max_states,
                            depth=depth, compile_async=compile_async,
-                           stop=stop)
+                           stop=stop, tracer=tracer)
         budgets = {job.job_id: job.options.wall_s
                    for job, adm, rec in admitted
                    if job.options.wall_s is not None}
-        outcomes = ex.run([(job.job_id, adm.config)
-                           for job, adm, rec in admitted],
-                          telemetry=telemetry, budgets=budgets)
+        try:
+            outcomes = ex.run([(job.job_id, adm.config)
+                               for job, adm, rec in admitted],
+                              telemetry=telemetry, budgets=budgets)
+        finally:
+            if sched_log is not None:
+                sched_log.close()
 
     for job, adm, rec in admitted:
         oc = outcomes[job.job_id]
@@ -533,6 +554,12 @@ def build_argparser() -> argparse.ArgumentParser:
                         "executes in-process")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend")
+    p.add_argument("--trace", action="store_true",
+                   help="emit schema-v8 trace spans (RAFT_TLA_TRACE): "
+                        "per-tenant engine phases into each tenant log "
+                        "plus scheduler dispatch/harvest/compile/ticket "
+                        "spans into OUT/sched-<pid>.events; merge with "
+                        "raft-tla-trace")
     p.add_argument("--quiet", action="store_true",
                    help="suppress per-job progress lines")
     p.add_argument("--drain-on-sigint", action="store_true",
@@ -546,6 +573,12 @@ def build_argparser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
+    if args.trace:
+        # Process-wide so pool worker children (plain serve CLIs spawned
+        # with the inherited environment) trace too — the gate pattern
+        # every RAFT_TLA_* knob follows.
+        from raft_tla_tpu.obs.trace import ENV_TRACE
+        os.environ[ENV_TRACE] = "1"
     if args.cpu:
         import jax
         try:

@@ -26,6 +26,15 @@ Worker exceptions are captured and re-raised on the main thread at the
 next `submit`/`collect`/`drain`, so a flush failure cannot be silently
 swallowed.  Gated by ``RAFT_TLA_HOSTDEDUP`` (utils/keyset.py); the
 ``off`` arm never constructs a worker.
+
+Attribution: the flush itself runs off the main thread, so without help
+it is invisible to both ``--phase-timers`` (whose buckets used to be
+main-thread-only) and traces.  Pass ``phases=`` (a
+``PhaseTimers``; duck-typed, may be None) and each flush accrues a
+``dedup@raft-tla-flush`` bucket and — when tracing is on — emits a v8
+``dedup`` span on its own thread track, making the overlap (or lack of
+it) visible in the merged timeline next to the main thread's
+``dedup_submit``/``dedup_wait``.
 """
 
 from __future__ import annotations
@@ -39,8 +48,10 @@ class DedupWorker:
     """Run ``fn(batch) -> n_new`` on a background thread, one batch at a
     time, in submission order."""
 
-    def __init__(self, fn: Callable[[Any], int], *, name: str = "raft-tla-flush"):
+    def __init__(self, fn: Callable[[Any], int], *,
+                 name: str = "raft-tla-flush", phases=None):
         self._fn = fn
+        self._phases = phases                 # PhaseTimers | None
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._slot = threading.Semaphore(1)   # depth-1 backpressure
         self._lock = threading.Lock()
@@ -60,7 +71,11 @@ class DedupWorker:
                 return
             batch, _n_keys = item
             try:
-                n_new = int(self._fn(batch))
+                if self._phases is not None:
+                    with self._phases.phase("dedup"):
+                        n_new = int(self._fn(batch))
+                else:
+                    n_new = int(self._fn(batch))
                 with self._lock:
                     self._done_new += n_new
             except BaseException as e:        # noqa: BLE001 — re-raised on main

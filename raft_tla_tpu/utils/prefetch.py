@@ -89,8 +89,14 @@ class BlockPrefetcher:
     """
 
     def __init__(self, loader: Callable[[int, int, int], Any], *,
-                 slots: int = 2, name: str = "raft-tla-prefetch"):
+                 slots: int = 2, name: str = "raft-tla-prefetch",
+                 phases=None, tracer=None):
         self._loader = loader
+        self._phases = phases               # PhaseTimers | None: the
+        # worker-side stage accrues a prefetch@<thread> bucket (and a
+        # span) so background reads are attributed, not invisible
+        self._tracer = tracer               # SpanTracer | None: take()
+        # emits a hit/miss-tagged span nested under the engine's upload
         self._slots = int(slots)
         self._next_slot = 0
         self._gen = 0                       # bumped by invalidate()
@@ -120,7 +126,11 @@ class BlockPrefetcher:
                 self._req = None
                 self._busy = True
             try:
-                res, err = self._loader(start, rows, slot), None
+                if self._phases is not None:
+                    with self._phases.phase("prefetch"):
+                        res, err = self._loader(start, rows, slot), None
+                else:
+                    res, err = self._loader(start, rows, slot), None
             except BaseException as e:      # noqa: BLE001 — re-raised on main
                 res, err = None, e
             with self._cv:
@@ -156,6 +166,15 @@ class BlockPrefetcher:
         matching in-flight stage (hit), else loads synchronously on the
         calling thread (miss).  Either way the worker is quiescent when
         this returns."""
+        tr = self._tracer
+        if tr is not None and tr.enabled:
+            with tr.span("take", start=int(start), rows=int(rows)) as sp:
+                res, hit = self._take(start, rows)
+                sp.set(hit=hit)
+                return res
+        return self._take(start, rows)[0]
+
+    def _take(self, start: int, rows: int) -> tuple:
         t0 = time.perf_counter()
         with self._cv:
             self._reraise_locked()
@@ -168,13 +187,13 @@ class BlockPrefetcher:
                     and (r[1], r[2]) == (start, rows):
                 self.hits += 1
                 self.wait_s += time.perf_counter() - t0
-                return r[3]
+                return r[3], True
             slot = self._next_slot
             self._next_slot = (slot + 1) % self._slots
         self.misses += 1
         res = self._loader(start, rows, slot)
         self.wait_s += time.perf_counter() - t0
-        return res
+        return res, False
 
     def invalidate(self) -> None:
         """Discard staged and pending work; block until the worker is

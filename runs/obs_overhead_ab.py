@@ -5,8 +5,10 @@ Claim under test: with no listener attached (no ``--events``, no
 nothing measurable — ``tel.active`` is False so the engines skip every
 per-segment device fetch, and ``phases.phase()`` returns a shared no-op
 handle.  The priced arms then show what turning the instruments ON
-costs: the events log (async writer + per-segment fetch) and the phase
-timers (a device sync per phase — the documented pipelining trade).
+costs: the events log (async writer + per-segment fetch), v8 trace
+spans (host-side span emission through the same log — NO device syncs,
+the pipelining survives), and the phase timers (a device sync per
+phase — the documented pipelining trade).
 
 Protocol (the chip-state-fiducial discipline of RESULTS.md "sig-prune
 A/B"): arms interleave round-robin so machine drift hits all arms
@@ -38,6 +40,7 @@ import jax.numpy as jnp
 from raft_tla_tpu.config import Bounds, CheckConfig
 from raft_tla_tpu.device_engine import Capacities, DeviceEngine
 from raft_tla_tpu.obs.phases import ENV_PHASE_TIMERS
+from raft_tla_tpu.obs.trace import ENV_TRACE
 
 RUNS = os.path.dirname(os.path.abspath(__file__))
 OUT = os.path.join(RUNS, "bench_obs_ab.out")
@@ -72,21 +75,25 @@ def fiducial() -> dict:
 def run_arm(arm: str, tmp: str) -> float:
     events = None
     os.environ.pop(ENV_PHASE_TIMERS, None)
+    os.environ.pop(ENV_TRACE, None)
     if arm != "off":
         events = os.path.join(tmp, f"{arm}-{time.monotonic_ns()}.events")
     if arm == "events+timers":
         os.environ[ENV_PHASE_TIMERS] = "1"
+    if arm == "events+trace":
+        os.environ[ENV_TRACE] = "1"
     t0 = time.monotonic()
     r = DeviceEngine(CFG, CAPS).check(events=events)
     wall = time.monotonic() - t0
     os.environ.pop(ENV_PHASE_TIMERS, None)
+    os.environ.pop(ENV_TRACE, None)
     assert r.n_states == N_EXPECT and r.complete, (arm, r.n_states)
     return wall
 
 
 def main():
     reps = int(sys.argv[1]) if len(sys.argv) > 1 else 3
-    arms = ("off", "events", "events+timers")
+    arms = ("off", "events", "events+trace", "events+timers")
     walls: dict = {a: [] for a in arms}
     with tempfile.TemporaryDirectory() as tmp, open(OUT, "a") as out:
         for rep in range(reps):
@@ -106,6 +113,7 @@ def main():
             "reps": reps,
             "median_wall_s": {a: round(m, 2) for a, m in med.items()},
             "events_over_off": round(med["events"] / med["off"], 4),
+            "trace_over_off": round(med["events+trace"] / med["off"], 4),
             "timers_over_off": round(med["events+timers"] / med["off"], 4),
         }
         print(json.dumps(summary))

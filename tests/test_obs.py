@@ -144,7 +144,8 @@ def test_validate_rejects_unknowns_and_type_drift():
     assert validate_event({**ok, "v": 5}) == []             # v5 superset
     assert validate_event({**ok, "v": 6}) == []             # v6 superset
     assert validate_event({**ok, "v": 7}) == []             # v7 superset
-    assert validate_event({**ok, "v": 8})                   # future version
+    assert validate_event({**ok, "v": 8}) == []             # v8 superset
+    assert validate_event({**ok, "v": 9})                   # future version
     assert validate_event({"v": 1, "event": "level_end", "ts": 0.0,
                            "level": 3})                     # missing field
 
@@ -240,6 +241,38 @@ def test_validate_v7_pool_supervision_events():
                            "detail": "killed its worker 3x"}) == []
     assert validate_event({**quar, "v": 6})
     assert validate_event({**quar, "surprise": 1})        # unknown field
+
+
+def test_validate_v8_span_events():
+    """Trace spans (obs/trace.py) exist only from schema v8 — event-type
+    gated like the v7 pool lifecycle; the ``run_start`` clock anchor and
+    host context are field-gated like the v3..v6 additions, so a v7
+    consumer never sees any of it."""
+    span = {"v": 8, "event": "span", "ts": 0.0, "name": "expand",
+            "span_id": 3, "t0": 12.25, "dur": 0.125,
+            "thread": "MainThread"}
+    assert validate_event(span) == []
+    assert validate_event({**span, "parent_id": 1,
+                           "args": {"rows": 256}}) == []
+    errs = validate_event({**span, "v": 7})  # v8-only type on a v7 line
+    assert errs and all("requires schema version >= 8" in e for e in errs)
+    assert validate_event({**span, "span_id": "3"})       # type drift
+    assert validate_event({**span, "span_id": True})      # bool ≠ int
+    assert validate_event({**span, "dur": "fast"})        # type drift
+    assert validate_event({**span, "surprise": 1})        # unknown field
+    assert validate_event({"v": 8, "event": "span", "ts": 0.0,
+                           "name": "expand", "span_id": 3,
+                           "t0": 1.0, "dur": 0.1})        # missing thread
+
+    start = {"v": 8, "event": "run_start", "ts": 0.0, "engine": "ddd",
+             "universe": {}, "spec": "election", "invariants": [],
+             "resumed": False,
+             "anchor": {"wall": 1.0, "mono": 2.0, "err_s": 1e-6},
+             "host": {"nproc": 4}}
+    assert validate_event(start) == []
+    errs = validate_event({**start, "v": 7})  # v8-only fields, v7 line
+    assert errs and all("requires schema version >= 8" in e for e in errs)
+    assert validate_event({**start, "anchor": [1.0]})     # type drift
 
 
 def test_monitor_pool_attribution_rows(tmp_path):

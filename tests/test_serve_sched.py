@@ -76,7 +76,7 @@ _MANIFEST = ([(f"m1-{i}", dict(max_msgs=1)) for i in range(6)]
 # wall-clock, rates, and the pipeline-occupancy annotation itself.
 _VOLATILE = frozenset({"ts", "wall_s", "states_per_sec",
                        "inc_states_per_sec", "admission_s", "inflight",
-                       "phase_s", "pid", "git_sha"})
+                       "phase_s", "pid", "git_sha", "anchor"})
 
 
 def _jobs():

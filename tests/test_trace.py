@@ -1,0 +1,407 @@
+"""Trace layer: spans, clock alignment, Perfetto export, attribution.
+
+The contract under test is PR 17's tentpole: with ``--trace`` on, every
+process in a run (engines, scheduler, pool supervisor) emits schema-v8
+``span`` events into its own log, each log carries a wall/monotonic
+anchor, and the collector merges them onto ONE wall axis with the skew
+bounded by the recorded anchor error; with tracing off (the default),
+every instrumentation site touches one shared null handle and the logs
+are byte-compatible with v7 consumers.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from raft_tla_tpu.config import Bounds, CheckConfig
+from raft_tla_tpu.obs import collect as obs_collect
+from raft_tla_tpu.obs import perfetto as obs_perfetto
+from raft_tla_tpu.obs.events import append_event, validate_event
+from raft_tla_tpu.obs.phases import PhaseTimers
+from raft_tla_tpu.obs.trace import (NULL_TRACER, SpanTracer, clock_anchor,
+                                    trace_enabled, tracer_for)
+
+CFG = CheckConfig(
+    bounds=Bounds(n_servers=2, n_values=1, max_term=2, max_log=0,
+                  max_msgs=2),
+    spec="election", invariants=("NoTwoLeaders",), chunk=32)
+N_TOY = 3014
+
+
+# --------------------------------------------------------------------------
+# span model
+
+
+def _capture_tracer():
+    rows = []
+    tr = SpanTracer(lambda event, **f: rows.append({"event": event, **f}))
+    return tr, rows
+
+
+def test_span_nesting_parent_ids_and_set():
+    tr, rows = _capture_tracer()
+    with tr.span("outer", a=1):
+        assert tr.current_id() == 1
+        with tr.span("inner") as sp:
+            assert tr.current_id() == 2
+            sp.set(rows=256)
+    assert tr.current_id() is None
+    # inner emitted first (exit order), parented to outer
+    inner, outer = rows
+    assert inner["name"] == "inner" and inner["parent_id"] == 1
+    assert inner["args"] == {"rows": 256}
+    assert outer["name"] == "outer" and "parent_id" not in outer
+    assert outer["args"] == {"a": 1}
+    assert outer["t0"] <= inner["t0"]
+    assert inner["dur"] <= outer["dur"]
+
+
+def test_span_thread_attribution_is_per_thread():
+    tr, rows = _capture_tracer()
+
+    def work():
+        with tr.span("bg"):
+            # a fresh thread has its own stack: no parent inherited
+            # from the main thread's open span
+            assert tr.current_id() is not None
+
+    with tr.span("main_work"):
+        t = threading.Thread(target=work, name="bg-thread")
+        t.start()
+        t.join()
+    by = {r["name"]: r for r in rows}
+    assert by["bg"]["thread"] == "bg-thread"
+    assert "parent_id" not in by["bg"]
+    assert by["main_work"]["thread"] == threading.current_thread().name
+
+
+def test_manual_spans_ride_synthetic_tracks():
+    tr, rows = _capture_tracer()
+    t0 = time.monotonic()
+    tr.emit_span("ticket", t0, 0.5, thread="tickets", bin="b0")
+    tr.emit_span("worker", t0, -1.0, thread="workers")  # clamped
+    assert rows[0]["thread"] == "tickets"
+    assert rows[0]["args"] == {"bin": "b0"}
+    assert rows[1]["dur"] == 0.0
+    assert rows[0]["span_id"] != rows[1]["span_id"]
+
+
+def test_spans_validate_at_schema_v8(tmp_path):
+    log = str(tmp_path / "t.events")
+    tr = tracer_for(log)
+    with tr.span("expand", rows=4):
+        pass
+    d = json.loads(open(log).read())
+    assert d["event"] == "span" and validate_event(d) == []
+
+
+# --------------------------------------------------------------------------
+# off path
+
+
+def test_off_path_is_one_shared_handle():
+    assert not trace_enabled("")
+    assert not trace_enabled("off")
+    assert trace_enabled("1") and trace_enabled("on")
+    s1 = NULL_TRACER.span("a", x=1)
+    s2 = NULL_TRACER.span("b")
+    assert s1 is s2                      # no per-call allocation
+    with s1 as sp:
+        assert sp.set(y=2) is sp
+    assert NULL_TRACER.current_id() is None
+    NULL_TRACER.emit_span("x", 0.0, 1.0)  # no-op, nothing to observe
+
+
+def _ok_result():
+    from types import SimpleNamespace
+    return SimpleNamespace(n_states=1, n_transitions=1, complete=True,
+                           violation=None, diameter=1, levels=[1],
+                           wall_s=0.1)
+
+
+def test_untraced_run_emits_no_spans_and_null_tracer(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.delenv("RAFT_TLA_TRACE", raising=False)
+    from raft_tla_tpu.obs.events import RunTelemetry
+    tel = RunTelemetry("ddd", config=CFG,
+                       events=str(tmp_path / "off.events"))
+    assert tel.trace is NULL_TRACER
+    tel.run_start()
+    with tel.phases.phase("expand"):
+        pass
+    tel.run_end(_ok_result())
+    tel.close()
+    evs = [json.loads(l) for l in open(tmp_path / "off.events")]
+    assert [e["event"] for e in evs] == ["run_start", "run_end"]
+    # the anchor rides run_start unconditionally (it is cheap and makes
+    # ANY log alignable); host context only when traced
+    assert "anchor" in evs[0] and "host" not in evs[0]
+
+
+def test_traced_telemetry_attaches_tracer(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TLA_TRACE", "1")
+    from raft_tla_tpu.obs.events import RunTelemetry
+    tel = RunTelemetry("ddd", config=CFG,
+                       events=str(tmp_path / "on.events"))
+    assert tel.trace.enabled and tel.phases.tracer is tel.trace
+    tel.run_start()
+    with tel.phases.phase("expand"):
+        pass
+    tel.run_end(_ok_result())
+    tel.close()
+    evs = [json.loads(l) for l in open(tmp_path / "on.events")]
+    assert [e["event"] for e in evs] \
+        == ["run_start", "span", "run_end"]
+    assert "host" in evs[0]
+    assert evs[1]["name"] == "expand"
+    assert all(validate_event(e) == [] for e in evs)
+
+
+# --------------------------------------------------------------------------
+# PhaseTimers thread attribution (the v8 bugfix)
+
+
+def test_phase_timers_background_thread_buckets():
+    """Work timed on a non-owner thread lands in its own
+    ``{phase}@{thread}`` bucket instead of silently racing the owner's
+    accumulator — and the snapshot drains both."""
+    pt = PhaseTimers(enabled=True)
+
+    def work():
+        with pt.phase("dedup"):
+            time.sleep(0.01)
+
+    with pt.phase("dedup"):
+        time.sleep(0.01)
+    t = threading.Thread(target=work, name="raft-tla-flush")
+    t.start()
+    t.join()
+    snap = pt.snapshot()
+    assert set(snap) == {"dedup", "dedup@raft-tla-flush"}
+    assert snap["dedup"] > 0 and snap["dedup@raft-tla-flush"] > 0
+
+
+def test_phase_timers_trace_only_emits_spans_without_sync():
+    """A tracer on a DISABLED PhaseTimers still opens spans (trace-only
+    mode) but never syncs or accumulates — dispatch pipelining stays
+    intact and ``phase_s`` stays empty.  With both layers off the
+    handle is the shared null singleton."""
+    pt = PhaseTimers(enabled=False)
+    tr, rows = _capture_tracer()
+    pt.tracer = tr
+    with pt.phase("expand") as ph:
+        # sync() marks a value to block on — with timers disabled the
+        # exit path must never touch it (no jax sync in trace-only mode)
+        ph.sync(object())
+    assert [r["name"] for r in rows] == ["expand"]
+    assert pt.snapshot() == {}
+    pt.tracer = NULL_TRACER
+    assert pt.phase("expand") is pt.phase("upload")  # shared null handle
+
+
+# --------------------------------------------------------------------------
+# collector: clock alignment
+
+
+def _synthetic_log(path, engine, pid, wall0, mono0, spans,
+                   err_s=1e-6):
+    """A minimal anchored log: run_start + spans with process-local
+    monotonic t0 values (mono0 + offset)."""
+    append_event(path, "run_start", engine=engine, universe={},
+                 spec="", invariants=[], resumed=False, pid=pid,
+                 anchor={"wall": wall0, "mono": mono0, "err_s": err_s},
+                 host={"nproc": 1})
+    for i, (name, off, dur, thread) in enumerate(spans, 1):
+        append_event(path, "span", name=name, span_id=i,
+                     t0=mono0 + off, dur=dur, thread=thread)
+
+
+def test_two_process_clock_alignment(tmp_path):
+    """Two processes whose monotonic clocks started at wildly different
+    points record the SAME wall-time story; the collector aligns them
+    through their anchors to within the recorded error bound."""
+    a = str(tmp_path / "a.events")
+    b = str(tmp_path / "b.events")
+    wall = 1_700_000_000.0
+    # process a: mono started 50s ago; process b: 9000s ago
+    _synthetic_log(a, "ddd", 100, wall, 50.0,
+                   [("expand", 1.0, 0.5, "MainThread")])
+    _synthetic_log(b, "sched", 200, wall, 9000.0,
+                   [("dispatch", 1.0, 0.5, "MainThread")])
+    col = obs_collect.collect([a, b])
+    assert len(col["processes"]) == 2
+    sa, sb = col["spans"]
+    # both spans happened at wall+1.0 despite disjoint monotonic bases
+    assert abs(sa["ts"] - (wall + 1.0)) <= 1e-6
+    assert abs(sa["ts"] - sb["ts"]) <= 2 * col["skew_bound_s"] + 1e-9
+    assert col["skew_bound_s"] == 1e-6
+
+
+def test_collector_anchorless_fallback_and_mixed_versions(tmp_path):
+    """A log with no anchor (pre-v8 producer) degrades to the span's
+    append stamp minus duration — still placed, flagged unanchored —
+    and non-span/v7 rows in the mix are passed through as instants."""
+    log = str(tmp_path / "old.events")
+    append_event(log, "run_start", engine="ddd", universe={},
+                 spec="", invariants=[], resumed=False, pid=7)
+    append_event(log, "span", name="expand", span_id=1, t0=123.0,
+                 dur=0.25, thread="MainThread")
+    append_event(log, "worker_spawn", worker="w0", pid=9)
+    d = [json.loads(l) for l in open(log)]
+    col = obs_collect.collect([log])
+    (proc,) = col["processes"]
+    assert proc["anchored"] is False and proc["skew_bound_s"] is None
+    (span,) = col["spans"]
+    assert abs(span["ts"] - (d[1]["ts"] - 0.25)) <= 1e-9
+    assert [i["name"] for i in col["instants"]] == ["worker_spawn"]
+
+
+# --------------------------------------------------------------------------
+# Perfetto export
+
+
+def test_perfetto_export_structure(tmp_path):
+    a = str(tmp_path / "a.events")
+    wall = 1_700_000_000.0
+    _synthetic_log(a, "ddd", 100, wall, 50.0,
+                   [("expand", 1.0, 0.5, "MainThread"),
+                    ("prefetch", 1.1, 0.2, "raft-tla-prefetch")])
+    append_event(a, "segment", wall_s=2.0, n_states=10, level=1,
+                 n_transitions=20, dedup_hit_rate=0.5,
+                 states_per_sec=5.0, inc_states_per_sec=5.0,
+                 since_resume=False)
+    append_event(a, "run_end", outcome="ok", n_states=10,
+                 n_transitions=20, complete=True)
+    col = obs_collect.collect([a])
+    out = str(tmp_path / "trace.json")
+    n = obs_perfetto.export(col, out)
+    doc = json.loads(open(out).read())
+    evs = doc["traceEvents"]
+    assert len(evs) == n
+    meta = [e for e in evs if e["ph"] == "M"]
+    names = {e["name"]: e for e in meta}
+    assert "process_name" in names
+    tthreads = {e["args"]["name"]: e["tid"] for e in meta
+                if e["name"] == "thread_name"}
+    assert tthreads["MainThread"] == 1          # main track first
+    assert tthreads["raft-tla-prefetch"] == 2
+    xs = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert xs["expand"]["dur"] == 0.5e6
+    # rebased to t_min: the earliest stamp in the collection is 0
+    assert min(e["ts"] for e in evs if e["ph"] != "M") == 0.0
+    assert [e for e in evs if e["ph"] == "C"]   # the rate counter
+    assert [e for e in evs if e["ph"] == "i"]   # run_end instant
+
+
+# --------------------------------------------------------------------------
+# end-to-end: traced engine run, report attribution, CLI
+
+
+@pytest.mark.smoke
+def test_traced_ddd_run_report_attribution(tmp_path, monkeypatch):
+    """The acceptance bar on one process: a traced toy ddd run (host
+    dedup + prefetch on) collects into a timeline whose main thread is
+    >= 95% attributed to named phases, with the prefetch thread on its
+    own track — and the traced result equals the untraced oracle."""
+    monkeypatch.setenv("RAFT_TLA_TRACE", "1")
+    monkeypatch.setenv("RAFT_TLA_HOSTDEDUP", "on")
+    monkeypatch.setenv("RAFT_TLA_PREFETCH", "on")
+    from raft_tla_tpu.ddd_engine import DDDCapacities, DDDEngine
+    log = str(tmp_path / "ddd.events")
+    eng = DDDEngine(CFG, DDDCapacities(block=256, table=1 << 14,
+                                       flush=1 << 10, levels=64))
+    res = eng.check(events=log)
+    assert res.n_states == N_TOY
+    evs = [json.loads(l) for l in open(log)]
+    assert all(validate_event(e) == [] for e in evs)
+    spans = [e for e in evs if e["event"] == "span"]
+    assert {s["name"] for s in spans} >= {"expand", "upload", "dedup"}
+    assert "raft-tla-prefetch" in {s["thread"] for s in spans}
+
+    col = obs_collect.collect(obs_collect.find_logs(str(tmp_path)))
+    rep = obs_collect.report(col)
+    (proc,) = rep["processes"]
+    main = proc["threads"]["MainThread"]
+    assert main["attributed_frac"] >= 0.95
+    assert abs(main["attributed_frac"] + main["gap_frac"] - 1.0) < 1e-9
+    assert proc["levels"], "level_end marks should yield critical path"
+    text = obs_collect.render_report(rep)
+    assert "MainThread" in text and "expand" in text
+
+    # the CLI over the same directory: collect, export, report
+    from raft_tla_tpu.obs.tracecli import main as trace_main
+    out = str(tmp_path / "trace.json")
+    assert trace_main(["export", str(tmp_path), "-o", out]) == 0
+    doc = json.loads(open(out).read())
+    assert any(e["ph"] == "X" for e in doc["traceEvents"])
+    assert trace_main(["collect", str(tmp_path)]) == 0
+    assert trace_main(["report", str(tmp_path), "--json"]) == 0
+
+
+@pytest.mark.smoke
+def test_pool_run_merges_into_one_timeline(tmp_path, monkeypatch):
+    """The multi-process acceptance bar: a traced --workers 2 pool run
+    leaves logs that collect into ONE timeline — pool supervisor with
+    worker-lifetime spans, each worker's scheduler with dispatch/
+    harvest/ticket spans, each tenant engine with phase spans — all
+    anchored, distinct pids, Perfetto-exportable."""
+    monkeypatch.setenv("RAFT_TLA_TRACE", "1")
+    from test_cli import write_cfg
+
+    from raft_tla_tpu.serve.jobs import CheckJob, JobOptions
+    from raft_tla_tpu.serve.pool import run_pool
+    from raft_tla_tpu.serve.supervise import PoolPolicy
+    cfg = write_cfg(tmp_path / "toy.cfg")
+    opts = JobOptions(spec="election", max_term=2, max_log=0, max_msgs=1)
+    opts_sym = JobOptions(spec="election", max_term=2, max_log=0,
+                          max_msgs=1, symmetry=True)
+    jobs = [CheckJob("j0", opts, cfg_path=str(cfg)),
+            CheckJob("j1", opts_sym, cfg_path=str(cfg))]
+    out = str(tmp_path / "out")
+    recs = run_pool(jobs, out, workers=2, chunk=256, cpu=True,
+                    quiet=True,
+                    policy=PoolPolicy(poll_s=0.02, backoff_base_s=0.05,
+                                      backoff_cap_s=0.2,
+                                      backoff_jitter_seed=7))
+    assert all(r["status"] == "completed" for r in recs)
+
+    logs = obs_collect.find_logs(out)
+    assert any(p.endswith("pool.events") for p in logs)
+    assert sum("sched-" in os.path.basename(p) for p in logs) == 2
+    col = obs_collect.collect(logs)
+    by_engine = {}
+    for p in col["processes"]:
+        by_engine.setdefault(p["engine"], []).append(p)
+    assert len(by_engine["pool"]) == 1
+    assert len(by_engine["sched"]) == 2
+    assert len(by_engine["serve"]) == 2          # tenant logs
+    assert all(p["anchored"] for p in col["processes"])
+    assert col["skew_bound_s"] is not None
+    # >= 3 distinct OS processes: the supervisor + 2 workers (each
+    # worker contributes a sched row AND its tenant rows, same os_pid)
+    assert len({p["os_pid"] for p in col["processes"]}) >= 3
+    sched_os = {p["os_pid"] for p in by_engine["sched"]}
+    serve_os = {p["os_pid"] for p in by_engine["serve"]}
+    assert serve_os <= sched_os         # tenants ran inside the workers
+
+    sup = by_engine["pool"][0]
+    sup_spans = [s for s in col["spans"] if s["pid"] == sup["pid"]]
+    assert {s["name"] for s in sup_spans} >= {"worker"}
+    assert {s["thread"] for s in sup_spans} == {"workers"}
+    sched_spans = [s for s in col["spans"]
+                   if s["pid"] in {p["pid"] for p in by_engine["sched"]}]
+    assert {s["name"] for s in sched_spans} >= {"dispatch", "harvest",
+                                                "ticket", "compile"}
+    assert "tickets" in {s["thread"] for s in sched_spans}
+
+    rep = obs_collect.report(col)
+    assert len(rep["processes"]) == len(col["processes"])
+    out_json = str(tmp_path / "pool_trace.json")
+    n = obs_perfetto.export(col, out_json)
+    assert n > 0
+    doc = json.loads(open(out_json).read())
+    pids = {e["pid"] for e in doc["traceEvents"]}
+    assert len(pids) >= 3                        # distinct tracks

@@ -167,6 +167,44 @@ off_line="$(grep '^3014 distinct states found' "$SERVE_TMP/prefetch_off.out" \
          echo "  on:  $on_line"; echo "  off: $off_line"; exit 1; }
 echo "prefetch smoke ok: on/off byte-identical ($on_line)"
 
+echo "== trace smoke (v8 spans -> collect -> Perfetto -> report, CPU) =="
+# Tracing forced ON: the toy cfg runs through the ddd engine with span
+# emission into the event log, the trace CLI must collect, export and
+# attribute it — then the same run with tracing OFF must produce a
+# byte-identical result line (the off-path discipline in one grep).
+python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
+    --spec election --max-term 2 --max-log 0 --max-msgs 2 \
+    --engine ddd --chunk 32 --host-dedup on --prefetch on \
+    --events "$SERVE_TMP/trace.events" --trace \
+    --cpu --no-lint --no-trace \
+    | tee "$SERVE_TMP/trace_on.out" | tail -2
+grep -q "^3014 distinct states found" "$SERVE_TMP/trace_on.out" \
+    || { echo "trace smoke FAILED: expected 3014 states"; exit 1; }
+grep -q '"event": "span"' "$SERVE_TMP/trace.events" \
+    || { echo "trace smoke FAILED: no span events in the log"; exit 1; }
+python -m raft_tla_tpu.obs.tracecli collect "$SERVE_TMP/trace.events"
+python -m raft_tla_tpu.obs.tracecli export "$SERVE_TMP/trace.events" \
+    -o "$SERVE_TMP/trace.json"
+python -c "import json,sys; d=json.load(open(sys.argv[1])); \
+    assert any(e['ph'] == 'X' for e in d['traceEvents']), 'no spans'" \
+    "$SERVE_TMP/trace.json"
+python -m raft_tla_tpu.obs.tracecli report "$SERVE_TMP/trace.events" \
+    > "$SERVE_TMP/trace_report.out"
+head -8 "$SERVE_TMP/trace_report.out"
+python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
+    --spec election --max-term 2 --max-log 0 --max-msgs 2 \
+    --engine ddd --chunk 32 --host-dedup on --prefetch on \
+    --cpu --no-lint --no-trace \
+    > "$SERVE_TMP/trace_off.out"
+on_line="$(grep '^3014 distinct states found' "$SERVE_TMP/trace_on.out" \
+    | sed 's/, [0-9.]*s.*//')"
+off_line="$(grep '^3014 distinct states found' "$SERVE_TMP/trace_off.out" \
+    | sed 's/, [0-9.]*s.*//')"
+[ "$on_line" = "$off_line" ] \
+    || { echo "trace smoke FAILED: on/off result lines differ"; \
+         echo "  on:  $on_line"; echo "  off: $off_line"; exit 1; }
+echo "trace smoke ok: on/off byte-identical ($on_line)"
+
 echo "== chaos smoke (campaign SIGKILL + reshard 1->2->1, CPU) =="
 # The campaign supervisor's acceptance loop in miniature: reference run,
 # then SIGKILL after the 2nd checkpoint, auto-reshard across a 1->2->1
