@@ -115,6 +115,11 @@ def run_fiducial() -> None:
       disk-backed FileStore (prefetch gate pinned off), so upload-
       prefetch deltas are code-attributable rather than page-cache
       weather.
+    - ``d2h_export_rows_per_sec``: device->host harvest rate of an
+      export-shaped segment payload at a pinned row count (device-dedup
+      gate pinned off), so device-dedup A/B deltas — whose whole claim
+      is "fewer rows cross this path" — are read against a measured
+      per-row d2h cost rather than assumed PCIe datasheet numbers.
 
     ``words_per_sec`` is the orbit scan's analytic word traffic
     (chunk * actions * |G| * packed width) over the synthetic step
@@ -129,6 +134,7 @@ def run_fiducial() -> None:
     os.environ["RAFT_TLA_MEGAKERNEL"] = "off"
     os.environ["RAFT_TLA_HOSTDEDUP"] = "off"
     os.environ["RAFT_TLA_PREFETCH"] = "off"
+    os.environ["RAFT_TLA_DEVDEDUP"] = "off"
     # trace_emit_overhead_us pins the DISABLED path (the default every
     # untraced run pays) — tracing must be off in this child.
     os.environ["RAFT_TLA_TRACE"] = "off"
@@ -250,6 +256,25 @@ def run_fiducial() -> None:
         fs.close()
     store_read_mb_s = _NB * _BROWS * _W * 4 / (1 << 20) / dt_r
 
+    # -- pinned d2h export-harvest rate ------------------------------------
+    # The exact payload shape the ddd engines pull back per segment (two
+    # uint32 key words + packed rows + parent/lane/constraint columns),
+    # device_get at a pinned row count — the denominator the device-dedup
+    # A/B (runs/devdedup_ab.py) reads its saved-rows claim against.
+    _EROWS, _EREPS = 1 << 16, 8
+    ebufs = (jnp.zeros((_EROWS,), jnp.uint32),
+             jnp.zeros((_EROWS,), jnp.uint32),
+             jnp.zeros((_EROWS, 32), jnp.int32),
+             jnp.zeros((_EROWS,), jnp.int32),
+             jnp.zeros((_EROWS,), jnp.int32),
+             jnp.zeros((_EROWS,), jnp.int32))
+    jax.block_until_ready(ebufs)
+    jax.device_get(ebufs)                                # warm the path
+    t_e = time.monotonic()
+    for _ in range(_EREPS):
+        jax.device_get(ebufs)
+    d2h_rows_per_sec = _EROWS * _EREPS / (time.monotonic() - t_e)
+
     # -- pinned trace off-path cost ----------------------------------------
     # What every instrumentation site pays when tracing is OFF (the
     # default): a NULL_TRACER.span() context entry/exit — one shared
@@ -277,6 +302,7 @@ def run_fiducial() -> None:
                               2),
         "flush_keys_per_sec": round(flush_keys_per_sec, 1),
         "store_read_mb_s": round(store_read_mb_s, 1),
+        "d2h_export_rows_per_sec": round(d2h_rows_per_sec, 1),
         "trace_emit_overhead_us": round(trace_emit_us, 4),
     }))
 

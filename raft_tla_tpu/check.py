@@ -254,6 +254,21 @@ def build_argparser() -> argparse.ArgumentParser:
                         "Sets RAFT_TLA_PREFETCH process-wide; default: "
                         "leave the env/auto policy alone (auto = on iff "
                         "nproc >= 2 — RESULTS.md 'Upload prefetch A/B')")
+    p.add_argument("--device-dedup", default=None,
+                   choices=("auto", "on", "off", "hash", "sort"),
+                   help="device-resident exact within-level fingerprint "
+                        "dedup for the ddd engines (ops/devdedup.py): "
+                        "each segment's output buffers are filtered "
+                        "against an HBM set of the keys already streamed "
+                        "this level, so within-level duplicates never "
+                        "cross d2h — the host LSM keyset stays the exact "
+                        "cold tier and discovery stays byte-identical. "
+                        "'on'/'hash' uses the open-addressing table "
+                        "(device_engine's insert-if-absent protocol), "
+                        "'sort' the portable sorted-set arm. Sets "
+                        "RAFT_TLA_DEVDEDUP process-wide; default: leave "
+                        "the env/auto policy alone (auto is currently "
+                        "OFF — RESULTS.md 'Device dedup A/B')")
     p.add_argument("--lint", default="warn", choices=("warn", "strict"),
                    help="static width-safety pass (analysis/widthcheck) "
                         "before any step build: prove no transition can "
@@ -654,6 +669,11 @@ def main(argv=None) -> int:
         # (utils/prefetch.prefetch_enabled) by the ddd engine families.
         import os
         os.environ["RAFT_TLA_PREFETCH"] = args.prefetch
+    if args.device_dedup is not None:
+        # Same contract: resolved at engine construction
+        # (ops/devdedup.devdedup_backend) by the ddd engine families.
+        import os
+        os.environ["RAFT_TLA_DEVDEDUP"] = args.device_dedup
     from raft_tla_tpu.serve.sched import enable_compile_cache
     enable_compile_cache(args.compile_cache)
     _DEVICE_ENGINES = ("device", "paged", "streamed", "ddd", "shard",

@@ -70,6 +70,7 @@ from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.obs import RunTelemetry
 from raft_tla_tpu.ops import bitpack
+from raft_tla_tpu.ops import devdedup
 from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym_mod
@@ -897,6 +898,28 @@ def _build_segment(config: CheckConfig, caps: DDDCapacities, A: int,
     return segment
 
 
+def _dd_filter(backend):
+    """Devdedup export filter for one segment's output buffers: drop
+    every lane whose key already streamed this level (ops/devdedup) and
+    compact the survivors to the buffer head in stream order, so the
+    harvest's existing ``[:ns]`` slices transfer and append only rows
+    the master keyset would actually admit.  Jitted with dstate and
+    bufs donated — runs in dispatch order, so the set's serial carry
+    always reflects exactly the rows streamed before this segment."""
+    filt = devdedup.make_filter(backend)
+
+    def apply(dstate, bufs, cursor):
+        dstate, _keep, idx, new_n, hits = filt(
+            dstate, bufs.okey_hi, bufs.okey_lo, cursor)
+        bufs = SegBufs(
+            okey_hi=bufs.okey_hi[idx], okey_lo=bufs.okey_lo[idx],
+            orows=bufs.orows[idx], opar=bufs.opar[idx],
+            olane=bufs.olane[idx], ocon=bufs.ocon[idx])
+        return dstate, bufs, new_n, hits
+
+    return apply
+
+
 class DDDEngine:
     """Exhaustive checker whose exact dedup lives on the host — distinct-
     state capacity is host RAM, with no device fingerprint table in the
@@ -931,6 +954,17 @@ class DDDEngine:
         # discipline; also NOT part of _DigestCaps — checkpoints resume
         # across either gate setting.
         self._prefetch = prefetch.prefetch_enabled()
+        # RAFT_TLA_DEVDEDUP gate: device-resident exact within-level
+        # fingerprint set applied to each segment's output buffers
+        # before export (ops/devdedup) — drops rows the master keyset
+        # would reject anyway, shrinking d2h export volume by the
+        # within-level duplicate rate.  Same resolution discipline;
+        # also NOT part of _DigestCaps — a resumed set starts empty and
+        # merely re-streams, which the master dedups exactly.
+        self._devdedup = devdedup.devdedup_backend()
+        self._dd_apply = jax.jit(_dd_filter(self._devdedup),
+                                 donate_argnums=(0, 1)) \
+            if self._devdedup else None
         # Per-flush, per-partition merge budget: 8x the partition's
         # expected share of one flush covers the amortized LSM movement
         # (flush/parts keys in, each moved ~log2(N/flush) ~ 7 times at
@@ -953,6 +987,10 @@ class DDDEngine:
             tbl_hi=jnp.full((TB, BUCKET), _EMPTY, U32),
             tbl_lo=jnp.full((TB, BUCKET), _EMPTY, U32),
             c=jnp.int32(0))
+
+    def _init_devset(self):
+        return jax.device_put(
+            devdedup.init_set(self.caps.table, self._devdedup))
 
     def _make_bufs(self) -> SegBufs:
         OCAP = self.caps.seg_rows
@@ -1166,6 +1204,9 @@ class DDDEngine:
             blocks_done = 0
 
         fc = self._init_filter()                # filter ≠ correctness:
+        dst = self._init_devset() if self._dd_apply else None
+        export_rows = 0      # rows actually exported d2h (post-filter)
+        dd_hits = 0          # rows the device set dropped pre-export
         bufsets = [self._make_bufs(), self._make_bufs()]
         pend = {"keys": [], "rows": [], "par": [],  # resume starts empty
                 "lane": [], "con": []}
@@ -1267,7 +1308,9 @@ class DDDEngine:
                 flush_backlog=worker.backlog() if worker else None,
                 upload_wait_ms=round(prefetcher.wait_s * 1e3, 3)
                 if prefetcher else None,
-                prefetch_hits=prefetcher.hits if prefetcher else None)
+                prefetch_hits=prefetcher.hits if prefetcher else None,
+                export_rows=export_rows,
+                dev_dedup_hits=dd_hits if self._dd_apply else None)
 
         n_trans_mark = n_trans   # n_trans as of the current block's start
         while not stopped:
@@ -1355,12 +1398,23 @@ class DDDEngine:
                                 fc, bufsets[idx], fbuf, fcon,
                                 jnp.int32(budget), jnp.int32(b_rows))
                             ph.sync(stats)
-                        q.append((idx, stats, t_disp))
+                        ncur = dhits = None
+                        if self._dd_apply is not None:
+                            # applied in dispatch order (== stream
+                            # order): the set's serial carry reflects
+                            # exactly the rows streamed before this
+                            # segment, so drops are provably re-sights
+                            with tel.phases.phase("devdedup") as ph:
+                                dst, bufsets[idx], ncur, dhits = \
+                                    self._dd_apply(dst, bufsets[idx],
+                                                   stats.cursor)
+                                ph.sync(ncur)
+                        q.append((idx, stats, ncur, dhits, t_disp))
                         if len(q) < 2:
                             continue         # keep the pipeline full
                     if not q:                # stop landed with nothing
                         break                # in flight
-                    idx, stats, t_disp = q.pop(0)
+                    idx, stats, ncur, dhits, t_disp = q.pop(0)
                     # Stats first (tiny); the OCAP-sized buffers transfer
                     # only when the segment streamed anything.  The full-
                     # buffer transfer (vs the old jitted prefix slice) is
@@ -1373,7 +1427,11 @@ class DDDEngine:
                     # skip it entirely.
                     with tel.phases.phase("export"):
                         st_h = jax.device_get(stats)
-                        ns, nv = int(st_h.cursor), int(st_h.n_valid)
+                        # gate on: the harvest slices the POST-filter
+                        # cursor — dropped rows never cross d2h at all
+                        ns = int(st_h.cursor) if ncur is None \
+                            else int(jax.device_get(ncur))
+                        nv = int(st_h.n_valid)
                         vk = int(st_h.viol_kind)
                         route_peak = max(route_peak, int(st_h.peak))
                         bufs_h = jax.device_get(bufsets[idx]) \
@@ -1383,7 +1441,10 @@ class DDDEngine:
                         continue             # drop post-stop segments
                     n_trans += nv
                     fail |= int(st_h.fail)
+                    if dhits is not None:
+                        dd_hits += int(jax.device_get(dhits))
                     if ns:
+                        export_rows += ns
                         # .copy(): a bare slice would pin the whole OCAP
                         # transfer buffer in pend until the next flush
                         pend["keys"].append(keyset.pack_keys(
@@ -1476,6 +1537,13 @@ class DDDEngine:
             if n_states == level_ends[-1]:       # no new states: done
                 break
             level_ends.append(n_states)
+            if self._dd_apply is not None:
+                # the set is within-level by contract: reset it empty
+                # at every boundary so capacity tracks one level's
+                # stream, not the whole run (a next-level re-sight of a
+                # previous-level state streams and the master drops it,
+                # exactly as with the gate off)
+                dst = self._init_devset()
             if prefetcher is not None:
                 # quiesce before any rotation/teardown below; by now the
                 # last take() consumed the final scheduled block, so

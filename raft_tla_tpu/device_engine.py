@@ -107,9 +107,13 @@ class Capacities:
 def _dedup_insert(tbl_hi, tbl_lo, key_hi, key_lo, active):
     """Batched insert-if-absent of fingerprint pairs into the hash set.
 
-    Returns ``(tbl_hi, tbl_lo, is_new, probe_fail)``.  ``is_new[c]`` is True
+    Returns ``(tbl_hi, tbl_lo, is_new, unres)``.  ``is_new[c]`` is True
     iff candidate c's key was absent and c is the *first* active candidate
-    (smallest flat index) carrying that key in this batch.
+    (smallest flat index) carrying that key in this batch.  ``unres[c]``
+    is True iff lane c's probe was still unresolved at ``_MAX_PROBE`` —
+    its key was neither matched nor inserted.  The table engines treat
+    any unresolved lane as fatal (``jnp.any(unres) * FAIL_PROBE``); the
+    devdedup filter instead streams such lanes to the exact host tier.
 
     Two-stage design (dedup is the chunk pipeline's hottest stage —
     measured 30 ms of a 53 ms chunk before these changes):
@@ -201,7 +205,7 @@ def _dedup_insert(tbl_hi, tbl_lo, key_hi, key_lo, active):
     init = (tbl_hi, tbl_lo, probe, jnp.zeros((BA,), bool), jnp.int32(0),
             jnp.zeros((BA,), I32))
     tbl_hi, tbl_lo, unres, is_new, _, _ = jax.lax.while_loop(cond, body, init)
-    return tbl_hi, tbl_lo, is_new, jnp.any(unres)
+    return tbl_hi, tbl_lo, is_new, unres
 
 
 # Failure bitmask (the "fail loudly" contract, SURVEY §4.5).
@@ -346,7 +350,7 @@ def _build_segment(config: CheckConfig, caps: Capacities, A: int, W: int):
         fvalid = valid.reshape(-1)
         tbl_hi, tbl_lo, is_new, pfail = _dedup_insert(
             tbl_hi, tbl_lo, fhi, flo, fvalid)
-        fail = fail | pfail * FAIL_PROBE
+        fail = fail | jnp.any(pfail) * FAIL_PROBE
 
         # Append new states to the store in discovery order.
         pos = n_states + jnp.cumsum(is_new.astype(I32)) - 1

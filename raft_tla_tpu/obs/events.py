@@ -127,12 +127,20 @@ Version 8 adds the cross-process tracing layer (obs/trace.py — gated by
 ``run_start.host`` host context for cross-session comparison (nproc,
                    jax version, backend)
 
+Version 9 adds ddd device-dedup attribution (ops/devdedup — gated by
+``--device-dedup`` / ``RAFT_TLA_DEVDEDUP``): segment ``export_rows``
+(cumulative rows actually exported d2h, post-filter; emitted by the DDD
+engines regardless of the gate so A/B off arms stay comparable) and
+``dev_dedup_hits`` (cumulative rows the device set dropped pre-export;
+only present when the gate is on).
+
 A run log with no ``run_end`` means the process died — crash attribution
 for free.  The schema is strict: unknown fields fail validation and the
-v2/v7/v8-only event types (resp. v3/v4/v5/v6/v8-only fields) are invalid
-on a ``"v" < 2`` / ``"v" < 7`` / ``"v" < 8`` (resp. ``"v" < 3`` /
-``"v" < 4`` / ``"v" < 5`` / ``"v" < 6`` / ``"v" < 8``) line, so any
-addition requires a version bump (versioning policy in README.md).
+v2/v7/v8-only event types (resp. v3/v4/v5/v6/v8/v9-only fields) are
+invalid on a ``"v" < 2`` / ``"v" < 7`` / ``"v" < 8`` (resp. ``"v" < 3``
+/ ``"v" < 4`` / ``"v" < 5`` / ``"v" < 6`` / ``"v" < 8`` / ``"v" < 9``)
+line, so any addition requires a version bump (versioning policy in
+README.md).
 """
 
 from __future__ import annotations
@@ -145,8 +153,8 @@ import subprocess
 import threading
 import time
 
-SCHEMA_VERSION = 8
-_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)  # versions validate_event accepts
+SCHEMA_VERSION = 9
+_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)  # versions validate_event accepts
 
 # Environment knobs (set by check.py --events/--phase-timers; inherited by
 # liveness re-runs and bench children the same way RAFT_TLA_SIGPRUNE is).
@@ -242,6 +250,10 @@ _V6_FIELDS = {"segment": frozenset({"upload_wait_ms", "prefetch_hits"})}
 # and host context) — invalid on a "v" < 8 line.
 _V8_FIELDS = {"run_start": frozenset({"anchor", "host"})}
 
+# Fields that only exist from schema version 9 on (ddd device-dedup
+# attribution) — invalid on a "v" < 9 line.
+_V9_FIELDS = {"segment": frozenset({"export_rows", "dev_dedup_hits"})}
+
 _OPTIONAL = {
     "run_start": {"bounds": dict, "symmetry": list, "view": str,
                   "chunk": int, "caps": str, "n_states": int,
@@ -250,7 +262,8 @@ _OPTIONAL = {
     "segment": {"coverage": dict, "route_peak": int, "n_devices": int,
                 "inv_evals": dict, "phase_s": dict, "device_rates": list,
                 "bin": str, "inflight": int, "flush_backlog": int,
-                "upload_wait_ms": _NUM, "prefetch_hits": int},
+                "upload_wait_ms": _NUM, "prefetch_hits": int,
+                "export_rows": int, "dev_dedup_hits": int},
     "level_end": {},
     "checkpoint": {"n_states": int},
     "violation": {"kind": str},
@@ -311,6 +324,7 @@ def validate_event(d: dict) -> list:
     v5_only = _V5_FIELDS.get(ev, frozenset())
     v6_only = _V6_FIELDS.get(ev, frozenset())
     v8_only = _V8_FIELDS.get(ev, frozenset())
+    v9_only = _V9_FIELDS.get(ev, frozenset())
     for k, val in d.items():
         if k in _BASE or k in req:
             continue
@@ -329,6 +343,8 @@ def validate_event(d: dict) -> list:
             errs.append(f"{ev}: field {k!r} requires schema version >= 6")
         elif k in v8_only and d["v"] in _VERSIONS and d["v"] < 8:
             errs.append(f"{ev}: field {k!r} requires schema version >= 8")
+        elif k in v9_only and d["v"] in _VERSIONS and d["v"] < 9:
+            errs.append(f"{ev}: field {k!r} requires schema version >= 9")
     return errs
 
 
@@ -370,6 +386,8 @@ class ProgressRecord:
     flush_backlog: int | None = None  # ddd: background flushes pending
     upload_wait_ms: float | None = None  # ddd: cumulative upload wait
     prefetch_hits: int | None = None  # ddd: staged-buffer block uploads
+    export_rows: int | None = None    # ddd: cumulative d2h export rows
+    dev_dedup_hits: int | None = None  # ddd: device-set pre-export drops
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -417,7 +435,9 @@ class ProgressTracker:
                inflight: int | None = None,
                flush_backlog: int | None = None,
                upload_wait_ms: float | None = None,
-               prefetch_hits: int | None = None) -> ProgressRecord:
+               prefetch_hits: int | None = None,
+               export_rows: int | None = None,
+               dev_dedup_hits: int | None = None) -> ProgressRecord:
         wall = time.monotonic() - self.t0
         reported = n_states if n_incl is None else max(n_states, n_incl)
         if self._prev_n is None:  # unknown baseline: anchor, rate 0
@@ -452,6 +472,8 @@ class ProgressTracker:
             flush_backlog=flush_backlog,
             upload_wait_ms=upload_wait_ms,
             prefetch_hits=prefetch_hits,
+            export_rows=export_rows,
+            dev_dedup_hits=dev_dedup_hits,
         )
 
 
@@ -661,7 +683,9 @@ class RunTelemetry:
                 inflight: int | None = None,
                 flush_backlog: int | None = None,
                 upload_wait_ms: float | None = None,
-                prefetch_hits: int | None = None) -> ProgressRecord:
+                prefetch_hits: int | None = None,
+                export_rows: int | None = None,
+                dev_dedup_hits: int | None = None) -> ProgressRecord:
         rec = self.tracker.record(
             n_states, level, n_transitions, coverage=coverage,
             route_peak=route_peak, n_incl=n_incl,
@@ -670,7 +694,9 @@ class RunTelemetry:
             bin=bin, inflight=inflight,
             flush_backlog=flush_backlog,
             upload_wait_ms=upload_wait_ms,
-            prefetch_hits=prefetch_hits)
+            prefetch_hits=prefetch_hits,
+            export_rows=export_rows,
+            dev_dedup_hits=dev_dedup_hits)
         if self.log is not None:
             if self._last_level is not None and level > self._last_level:
                 # The boundary count is the count as observed at the first

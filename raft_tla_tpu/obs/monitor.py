@@ -246,6 +246,7 @@ def summarize(stream: dict, window_s: float = 600.0,
         "bin": cur.get("bin"),
         "inflight": cur.get("inflight"),
         "flush_backlog": cur.get("flush_backlog"),
+        "dev_dedup_hits": cur.get("dev_dedup_hits"),
         "level_sizes": _level_sizes(events, segments),
         "target": target,
         "legacy": stream["legacy"],
@@ -361,6 +362,10 @@ def heartbeat(summary: dict | None) -> str:
         # ddd background host dedup: 1 = a sealed flush was overlapping
         # device compute at the segment boundary (depth-1 worker)
         parts.append(f"flush backlog {summary['flush_backlog']}")
+    if summary.get("dev_dedup_hits") is not None:
+        # ddd device dedup: rows the HBM-resident within-level set kept
+        # off the d2h export path in this segment (schema v9)
+        parts.append(f"dev dedup {summary['dev_dedup_hits']:,}")
     if summary.get("pool"):
         parts.append(_fmt_pool(summary["pool"]))
     if summary.get("last_event_age_s") is not None:

@@ -36,7 +36,10 @@ contract instead — so ``dedup_wait`` fires only at
 checkpoint/level/stop drains (the on/off asymmetry is the gate's
 phase-timer signature), and ``upload`` becomes the wait for an
 already-staged buffer (a prefetch *hit* costs a swap; a *miss* pays
-the old read+pad+h2d inline).
+the old read+pad+h2d inline).  With device dedup
+(``RAFT_TLA_DEVDEDUP``) a ``devdedup`` phase covers the per-segment
+export-filter dispatch (ops/devdedup) — the on-device set membership
+pass that shrinks the subsequent ``export`` wall.
 
 **Thread attribution** (schema v8): phases recorded on a thread other
 than the one that built the ``PhaseTimers`` accumulate under

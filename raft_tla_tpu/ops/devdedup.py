@@ -1,0 +1,232 @@
+"""Device-resident exact within-level fingerprint dedup for the DDD
+engines (``RAFT_TLA_DEVDEDUP`` / ``--device-dedup``).
+
+The DDD loop's remaining structural host dependency (ROADMAP item 5):
+every candidate fingerprint — including within-level duplicates the
+lossy filter evicted and re-sighted — crosses the d2h tunnel to the
+master keyset.  This module is the hot tier of a two-tier dedup: an
+HBM-resident **exact** set of the fingerprints already streamed *this
+level*, applied to each segment's output buffers before export, so only
+first-occurrence-this-level rows are compacted and transferred.  The
+host LSM keyset (utils/keyset) stays the cold tier and the sole
+correctness authority.
+
+**Why dropping is sound (the widening argument, inverted).**  The set
+only ever contains keys that were *kept* — i.e. already exported to the
+host earlier this level.  A candidate is dropped iff its exact (hi, lo)
+key is present, so every dropped row is one ``master.dedup`` would have
+rejected as a duplicate; first occurrences always survive, in stream
+order, because compaction preserves relative order.  Therefore
+n_states, n_transitions (counted in-segment, pre-filter), parent
+choice (first discoverer), level boundaries, checkpoints, and
+violation/deadlock traces are byte-identical on vs off.  Every lossy
+path in the set itself — probe overflow, capacity truncation, the
+all-ones sentinel — resolves to *streaming* the candidate, never to
+dropping it: uncertainty widens the stream and the host dedups exactly,
+the same one-sided contract ``ddd_engine._filter_insert`` documents.
+
+Two interchangeable backends behind one ``(state, keys, n) -> (state,
+keep, idx, new_n, hits)`` interface:
+
+- ``"hash"``: a bucketized open-addressing (hi, lo) table driven by
+  ``device_engine._dedup_insert`` — the table engines' proven exact
+  insert-if-absent protocol (hashed claim domain, scatter-min first-
+  discoverer resolution, duplicate-free scatters).  A lane whose probe
+  is unresolved at ``_MAX_PROBE`` (table too full) simply streams and
+  is not inserted.
+- ``"sort"``: a portable sorted-array set — one stable
+  ``jax.lax.sort`` over (set ++ batch) keyed on (hi, lo) generalizes
+  ``ddd_engine._filter_insert``'s two-sort first-occurrence pass from
+  lossy filter to exact set: stability puts set entries before equal
+  batch lanes and batch lanes in stream order, so ``same_as_prev``
+  marks exactly the duplicates.  The union's first-occurrence keys
+  (smallest ``capacity`` of them on overflow) become the next set.
+  This arm has no while_loop and no claim protocol — the CPU /
+  interpret-mode arm and the parity oracle for ``"hash"``.
+
+Keys equal to the table sentinel (both words all-ones) are never
+inserted and always stream in BOTH backends — a real all-ones
+fingerprint would alias the hash table's empty slot and the sort
+backend's padding, so it is excluded identically (widening-safe), and
+backend keep-decisions stay equivalent.
+
+The set is **within-level** by construction: the engine resets it at
+every level boundary (and resume starts it empty — mid-level resumes
+just re-stream, which the master dedups).  The gate is resolved once at
+engine construction like sig-prune/hostdedup/prefetch and is
+deliberately NOT part of the checkpoint digest: snapshots resume across
+either gate setting in both directions.
+
+Auto policy: measured by ``runs/devdedup_ab.py`` per the sig-prune /
+hostdedup protocol (bracketing fiducials, interleaved reps, per-level
+export-row parity) — see ``_auto_backend`` below and RESULTS.md
+"Device dedup A/B".
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tla_tpu.device_engine import BUCKET, _EMPTY, _dedup_insert
+
+I32 = jnp.int32
+
+ENV_DEVDEDUP = "RAFT_TLA_DEVDEDUP"
+
+# The sort backend re-sorts (capacity + seg_rows) keys every segment, so
+# its set is clamped: beyond this the O((S+O) log(S+O)) pass dominates a
+# segment and overflowed keys just re-stream (widening-safe).
+_SORT_CAP = 1 << 17
+
+
+def _auto_backend() -> str | None:
+    """The ``auto`` verdict (runs/devdedup_ab.py, RESULTS.md "Device
+    dedup A/B"): on this 1-core CPU container the filter pass and the
+    harvest loop time-slice one core and d2h is a memcpy, so the
+    export-row reduction (measured exact — off rows == on rows + hits
+    held at all 74 parity segments — but only ~0.1% of rows at the
+    flagship shape, whose 2^22-slot filter leaks few within-level
+    re-sights) cost 0.43-0.44x warm rate instead of buying wall time —
+    the sig-prune precedent, honest refutation -> auto=OFF, with the
+    on-chip re-A/B queued under ROADMAP item 2 (PCIe d2h is where the
+    dropped rows are real bandwidth, and the eviction-heavy elect5
+    capacity regime is where the duplicate rate is not 0.1%)."""
+    return None
+
+
+def devdedup_backend(env: str | None = None) -> str | None:
+    """Resolve the device-dedup gate: None (off), ``"hash"`` or
+    ``"sort"``.  ``on`` forces the hash backend (the TPU-native arm);
+    ``hash``/``sort`` force a specific backend; ``auto`` (or unset)
+    applies the measured policy."""
+    v = (os.environ.get(ENV_DEVDEDUP, "") if env is None else env)
+    v = v.strip().lower()
+    if v in ("", "auto"):
+        return _auto_backend()
+    if v in ("0", "off", "false", "no"):
+        return None
+    if v in ("1", "on", "true", "yes", "hash"):
+        return "hash"
+    if v == "sort":
+        return "sort"
+    raise ValueError(
+        f"{ENV_DEVDEDUP}={v!r}: expected auto, on, off, hash or sort")
+
+
+class DevSet(NamedTuple):
+    """The device set between segments (serial state, donated).
+
+    hash: ``hi``/``lo`` are the ``[capacity // BUCKET, BUCKET]`` table
+    words (``_EMPTY`` = free slot), ``n`` unused (0).  sort: ``hi``/
+    ``lo`` are the ``[capacity]`` first-occurrence key array padded with
+    ``_EMPTY``, ``n`` the live entry count."""
+
+    hi: jax.Array
+    lo: jax.Array
+    n: jax.Array
+
+
+def init_set(capacity: int, backend: str) -> DevSet:
+    """Empty per-level set state as host numpy (callers device_put it —
+    the shard engine with a per-shard NamedSharding)."""
+    if backend == "hash":
+        if capacity & (capacity - 1):
+            raise ValueError(f"devdedup capacity {capacity} must be a "
+                             "power of two (bucket-mask probe)")
+        tb = max(capacity // BUCKET, 1)
+        return DevSet(hi=np.full((tb, BUCKET), _EMPTY, np.uint32),
+                      lo=np.full((tb, BUCKET), _EMPTY, np.uint32),
+                      n=np.int32(0))
+    if backend == "sort":
+        cap = min(capacity, _SORT_CAP)
+        return DevSet(hi=np.full((cap,), _EMPTY, np.uint32),
+                      lo=np.full((cap,), _EMPTY, np.uint32),
+                      n=np.int32(0))
+    raise ValueError(f"unknown devdedup backend {backend!r}")
+
+
+def _compact(keep, lane):
+    """Stream-order compaction gather: ``idx[:new_n]`` are the kept
+    lanes in original order (tail positions never read — the harvest
+    slices ``[:new_n]`` and the next segment rewrites from cursor 0)."""
+    OC = keep.shape[0]
+    kpos = jnp.cumsum(keep.astype(I32)) - 1
+    idx = jnp.zeros((OC,), I32).at[
+        jnp.where(keep, kpos, OC)].set(lane, mode="drop")
+    return idx, jnp.sum(keep.astype(I32))
+
+
+def _hash_filter(state: DevSet, key_hi, key_lo, n):
+    OC = key_hi.shape[0]
+    lane = jnp.arange(OC, dtype=I32)
+    valid = lane < n
+    sent = (key_hi == _EMPTY) & (key_lo == _EMPTY)
+    act = valid & ~sent
+    thi, tlo, is_new, unres = _dedup_insert(
+        state.hi, state.lo, key_hi, key_lo, act)
+    # keep = first-occurrence-this-level (inserted), sentinel, or probe-
+    # unresolved (not inserted — streams now and again if re-sighted);
+    # drop only lanes RESOLVED as exact duplicates.
+    keep = valid & (sent | is_new | unres)
+    hits = jnp.sum((valid & ~keep).astype(I32))
+    idx, new_n = _compact(keep, lane)
+    return DevSet(thi, tlo, state.n), keep, idx, new_n, hits
+
+
+def _sort_filter(state: DevSet, key_hi, key_lo, n):
+    OC = key_hi.shape[0]
+    S = state.hi.shape[0]
+    lane = jnp.arange(OC, dtype=I32)
+    valid = lane < n
+    sent = (key_hi == _EMPTY) & (key_lo == _EMPTY)
+    act = valid & ~sent
+    # Masked lanes sort into the all-ones padding run at the back; their
+    # dup flags are overridden by ``valid``/``sent`` below and the
+    # padding key is excluded from the rebuilt set.
+    chi = jnp.concatenate([state.hi, jnp.where(act, key_hi, _EMPTY)])
+    clo = jnp.concatenate([state.lo, jnp.where(act, key_lo, _EMPTY)])
+    src = jnp.concatenate([jnp.full((S,), -1, I32), lane])
+    shi, slo, ssrc = jax.lax.sort((chi, clo, src), num_keys=2,
+                                  is_stable=True)
+    # Stability: equal keys keep operand order — set entry first, then
+    # batch lanes in stream order — so same_as_prev marks exactly the
+    # non-first occurrences (the _filter_insert pass, made exact).
+    same = jnp.concatenate([
+        jnp.zeros((1,), bool),
+        (shi[1:] == shi[:-1]) & (slo[1:] == slo[:-1])])
+    dup = jnp.zeros((OC,), bool).at[
+        jnp.where(ssrc >= 0, ssrc, OC)].set(same, mode="drop")
+    keep = valid & (sent | ~dup)
+    hits = jnp.sum((valid & ~keep).astype(I32))
+    # Rebuild the set as the union's first-occurrence keys; on capacity
+    # overflow the largest keys fall out and simply re-stream later.
+    pad = (shi == _EMPTY) & (slo == _EMPTY)
+    uniq = ~same & ~pad
+    upos = jnp.cumsum(uniq.astype(I32)) - 1
+    tgt = jnp.where(uniq & (upos < S), upos, S)
+    nhi = jnp.full((S,), _EMPTY, jnp.uint32).at[tgt].set(shi, mode="drop")
+    nlo = jnp.full((S,), _EMPTY, jnp.uint32).at[tgt].set(slo, mode="drop")
+    nn = jnp.minimum(jnp.sum(uniq.astype(I32)), S)
+    idx, new_n = _compact(keep, lane)
+    return DevSet(nhi, nlo, nn), keep, idx, new_n, hits
+
+
+def make_filter(backend: str):
+    """The segment-output filter for ``backend``: ``filter_fn(state,
+    key_hi, key_lo, n) -> (state, keep, idx, new_n, hits)`` — pure and
+    jit/shard_map-safe.  ``n`` is the segment cursor (lanes >= n are
+    stale buffer contents and pass through masked); ``keep[lane]`` says
+    lane survives; ``idx``/``new_n`` are the order-preserving compaction
+    gather; ``hits`` counts dropped (already-streamed-this-level)
+    rows.  Shapes come from the arguments, so one filter serves any
+    (capacity, seg_rows) pairing."""
+    if backend == "hash":
+        return _hash_filter
+    if backend == "sort":
+        return _sort_filter
+    raise ValueError(f"unknown devdedup backend {backend!r}")

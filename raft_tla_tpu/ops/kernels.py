@@ -811,10 +811,14 @@ def step_signature(bounds, spec, invariants, symmetry, view):
 
     Gates resolve per call (env + backend), so compute the signature at
     the same time you build the step it stands for."""
+    # call-time import: devdedup imports device_engine, which imports
+    # this module — a top-level import would cycle
+    from raft_tla_tpu.ops import devdedup
     return (bounds, spec, tuple(invariants), tuple(symmetry), view,
             ("megakernel", _megakernel_enabled(bounds, symmetry)),
             ("prescan", _prescan_enabled(bounds, symmetry)),
-            ("sigprune", _sigprune_enabled(bounds, symmetry)))
+            ("sigprune", _sigprune_enabled(bounds, symmetry)),
+            ("devdedup", devdedup.devdedup_backend()))
 
 
 def _orbit_fp_prescan(orbit_fp, flat, raw_hi, raw_lo, N):

@@ -113,7 +113,7 @@ def _build_segment(config: CheckConfig, caps: PagedCapacities, A: int,
         fvalid = valid.reshape(-1)
         tbl_hi, tbl_lo, is_new, pfail = _dedup_insert(
             tbl_hi, tbl_lo, fhi, flo, fvalid)
-        fail = fail | pfail * FAIL_PROBE
+        fail = fail | jnp.any(pfail) * FAIL_PROBE
 
         # Append new states into the ring at (discovery index mod Rcap).
         pos = n_states + jnp.cumsum(is_new.astype(I32)) - 1

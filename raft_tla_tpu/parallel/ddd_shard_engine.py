@@ -82,6 +82,7 @@ from raft_tla_tpu.engine import DEADLOCK, EngineResult, Violation
 from raft_tla_tpu.models import interp, invariants as inv_mod, spec as S
 from raft_tla_tpu.obs import RunTelemetry
 from raft_tla_tpu.ops import bitpack
+from raft_tla_tpu.ops import devdedup
 from raft_tla_tpu.ops import kernels
 from raft_tla_tpu.ops import state as st
 from raft_tla_tpu.ops import symmetry as sym_mod
@@ -415,6 +416,37 @@ def _build_segment(config: CheckConfig, caps: DDDShardCapacities, A: int,
     return segment
 
 
+def _dd_filter_shard(backend):
+    """Per-shard devdedup export filter (ops/devdedup) for the local
+    view under shard_map: drop lanes whose key already streamed from
+    THIS shard this level and compact survivors in stream order.  Owner
+    routing funnels all duplicates of a key through one shard, but the
+    filter does not rely on it — a drop is sound whenever the key
+    streamed earlier from the *same* shard, which is exactly what the
+    per-shard set records.  ``viol_pos`` is a buffer SLOT, so it is
+    remapped through the compaction (the violator itself always
+    survives: an equal earlier candidate would have violated first and
+    stopped the run at its own segment)."""
+    filt = devdedup.make_filter(backend)
+
+    def apply(dstate, bufs, cursor, viol_pos):
+        stt = devdedup.DevSet(dstate.hi, dstate.lo, dstate.n[0])
+        stt, keep, idx, new_n, hits = filt(
+            stt, bufs.okey_hi, bufs.okey_lo, cursor[0])
+        nbufs = MBufs(
+            okey_hi=bufs.okey_hi[idx], okey_lo=bufs.okey_lo[idx],
+            orows=bufs.orows[idx], opar=bufs.opar[idx],
+            olane=bufs.olane[idx], ocon=bufs.ocon[idx])
+        vp = viol_pos[0]
+        kpos = jnp.cumsum(keep.astype(I32))
+        nvp = jnp.where(
+            vp >= 0, kpos[jnp.clip(vp, 0, keep.shape[0] - 1)] - 1, vp)
+        return (devdedup.DevSet(stt.hi, stt.lo, stt.n[None]), nbufs,
+                new_n[None], hits[None], nvp[None])
+
+    return apply
+
+
 class DDDShardEngine:
     """Mesh-wide exhaustive checker with host-exact sharded dedup."""
 
@@ -458,6 +490,14 @@ class DDDShardEngine:
         # only reads rows published before the level began, disjoint
         # from anything the window-boundary drain appends.
         self._prefetch = prefetch.prefetch_enabled()
+        # RAFT_TLA_DEVDEDUP: per-shard device-resident exact within-
+        # level sets filter each segment's output buffers before export
+        # (ops/devdedup).  Per-shard drops are sound regardless of key
+        # routing (a drop proves the key already streamed from the same
+        # shard), and the canonical (level, window, shard) drain order
+        # is untouched — the filter only thins each shard's stream.
+        # NOT part of the digest: resume across either gate setting.
+        self._devdedup = devdedup.devdedup_backend()
         self._merge_budget = max(1 << 16,
                                  (8 * self.caps.flush)
                                  // keyset.DEFAULT_PARTS)
@@ -479,6 +519,16 @@ class DDDShardEngine:
                           out_specs=(fc_specs, buf_specs, st_specs),
                           check_vma=False),
             donate_argnums=(0, 1))
+        self._dd_apply = None
+        if self._devdedup:
+            dd_specs = devdedup.DevSet(dp, dp, dp)
+            self._dd_apply = jax.jit(
+                _shard_map(_dd_filter_shard(self._devdedup),
+                           mesh=self.mesh,
+                           in_specs=(dd_specs, buf_specs, dp, dp),
+                           out_specs=(dd_specs, buf_specs, dp, dp, dp),
+                           check_vma=False),
+                donate_argnums=(0, 1))
         self._in_shardings = [
             NamedSharding(self.mesh, dp) for _ in range(4)]
         # window staging, lazy-alloc: one buffer set per prefetch slot
@@ -496,6 +546,16 @@ class DDDShardEngine:
             tbl_lo=jax.device_put(
                 np.full((self.ndev * TBd, BUCKET), _EMPTY, np.uint32), sh),
             c=jnp.int32(0))
+
+    def _init_devset(self):
+        one = devdedup.init_set(self.caps.table, self._devdedup)
+        nd = self.ndev
+        reps = (nd, 1) if one.hi.ndim == 2 else nd
+        sh = NamedSharding(self.mesh, P(self._ax))
+        return devdedup.DevSet(
+            hi=jax.device_put(np.tile(one.hi, reps), sh),
+            lo=jax.device_put(np.tile(one.lo, reps), sh),
+            n=jax.device_put(np.zeros((nd,), np.int32), sh))
 
     def _make_bufs(self) -> MBufs:
         OCAP = self.caps.seg_rows
@@ -767,6 +827,9 @@ class DDDShardEngine:
             blocks_done = 0
 
         fc = self._init_filter()
+        dst = self._init_devset() if self._dd_apply else None
+        export_rows = 0      # rows actually exported d2h (post-filter)
+        dd_hits = 0          # rows the per-shard device sets dropped
         bufsets = [self._make_bufs(), self._make_bufs()]
         pend = [{"keys": [], "rows": [], "par": [], "lane": [], "con": []}
                 for _ in range(self.ndev)]
@@ -826,7 +889,9 @@ class DDDShardEngine:
                 coverage=dict(aggregate_coverage(self.table, cov)),
                 upload_wait_ms=round(prefetcher.wait_s * 1e3, 3)
                 if prefetcher else None,
-                prefetch_hits=prefetcher.hits if prefetcher else None)
+                prefetch_hits=prefetcher.hits if prefetcher else None,
+                export_rows=export_rows,
+                dev_dedup_hits=dd_hits if self._dd_apply else None)
 
         while not stopped:
             lvl_lo = level_ends[-2] if len(level_ends) > 1 else 0
@@ -880,15 +945,30 @@ class DDDShardEngine:
                                 nrows, jnp.int32(budget),
                                 jnp.int32(n_chunks))
                             ph.sync(stats)
-                        q.append((idx, stats, t_disp))
+                        ncur = dhits = nvp = None
+                        if self._dd_apply is not None:
+                            # dispatch order == per-shard stream order,
+                            # so each shard's set carry reflects exactly
+                            # its rows streamed before this segment
+                            with tel.phases.phase("devdedup") as ph:
+                                (dst, bufsets[idx], ncur, dhits,
+                                 nvp) = self._dd_apply(
+                                    dst, bufsets[idx], stats.cursor,
+                                    stats.viol_pos)
+                                ph.sync(ncur)
+                        q.append((idx, stats, ncur, dhits, nvp, t_disp))
                         if len(q) < 2:
                             continue         # keep the pipeline full
                     if not q:
                         break
-                    idx, stats, t_disp = q.pop(0)
+                    idx, stats, ncur, dhits, nvp, t_disp = q.pop(0)
                     with tel.phases.phase("export"):
                         st_h = jax.device_get(stats)
-                        cursors = np.asarray(st_h.cursor)
+                        # gate on: harvest the POST-filter cursors —
+                        # dropped rows never cross d2h at all
+                        cursors = np.asarray(st_h.cursor) \
+                            if ncur is None \
+                            else np.asarray(jax.device_get(ncur))
                         bufs_h = jax.device_get(bufsets[idx]) \
                             if cursors.sum() and not stopped else None
                     free.append(idx)
@@ -914,9 +994,15 @@ class DDDShardEngine:
                         pend[s]["con"].append(
                             bufs_h.ocon[o:o + ns].copy())
                     n_trans += int(np.asarray(st_h.n_valid).sum())
+                    export_rows += int(cursors.sum())
+                    if dhits is not None:
+                        dd_hits += int(np.asarray(
+                            jax.device_get(dhits)).sum())
                     fail |= int(np.bitwise_or.reduce(
                         np.asarray(st_h.fail)))
-                    vpos = np.asarray(st_h.viol_pos)
+                    # gate on: viol_pos remapped through the compaction
+                    vpos = np.asarray(st_h.viol_pos) if nvp is None \
+                        else np.asarray(jax.device_get(nvp))
                     dgs = np.asarray(st_h.dead_g)
                     if fail:
                         stopped = True
@@ -1010,6 +1096,11 @@ class DDDShardEngine:
             if n_states == level_ends[-1]:       # no new states: done
                 break
             level_ends.append(n_states)
+            if self._dd_apply is not None:
+                # within-level sets by contract: reset empty at every
+                # boundary (re-sights of previous-level states stream
+                # and the per-shard masters drop them, as with gate off)
+                dst = self._init_devset()
             if prefetcher is not None:
                 # quiesce before rotation (no-op unless a stop raced the
                 # level end — the last take() consumed the final window)

@@ -217,7 +217,7 @@ def _build_segment(config: CheckConfig, caps: PagedShardCapacities, A: int,
         # ---- owner-side dedup + ring append ----
         tbl_hi, tbl_lo, is_new, pfail = _dedup_insert(
             tbl_hi, tbl_lo, r_hi, r_lo, active)
-        fail = fail | pfail * FAIL_PROBE
+        fail = fail | jnp.any(pfail) * FAIL_PROBE
         pos_st = n_states + jnp.cumsum(is_new.astype(I32)) - 1
         n_new = jnp.sum(is_new.astype(I32))
         # Ring-lap guard.  Two live regions must never be overwritten: the

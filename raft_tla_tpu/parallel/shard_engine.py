@@ -331,7 +331,7 @@ def _build_segment(config: CheckConfig, caps: ShardCapacities,
         # ---- owner-side dedup + append (same protocol as device_engine) ----
         tbl_hi, tbl_lo, is_new, pfail = _dedup_insert(
             tbl_hi, tbl_lo, r_hi, r_lo, active)
-        fail = fail | pfail * FAIL_PROBE
+        fail = fail | jnp.any(pfail) * FAIL_PROBE
         pos_st = n_states + jnp.cumsum(is_new.astype(I32)) - 1
         sl = jnp.where(is_new & (pos_st < Ncap), pos_st, Ncap)
         store = store.at[sl].set(r_vec, mode="drop")
@@ -902,7 +902,8 @@ def reshard_checkpoint(config: CheckConfig, caps_src: ShardCapacities,
             act[:s2.size] = True       # fixed batch shape: one compile
             th, tl, is_new, pf = ins(th, tl, jnp.asarray(kh),
                                      jnp.asarray(kl), jnp.asarray(act))
-            if bool(pf) or not bool(np.asarray(is_new)[:s2.size].all()):
+            if bool(np.asarray(pf).any()) or \
+                    not bool(np.asarray(is_new)[:s2.size].all()):
                 raise RuntimeError(
                     "table rebuild failed (probe overflow or duplicate "
                     "key) — grow caps_dst.table")

@@ -265,7 +265,14 @@ def run_service(jobs, out_dir: str, chunk: int = 1024,
                        levels=list(r.levels),
                        complete=bool(r.complete),
                        wall_s=round(r.wall_s, 3),
-                       states_per_sec=round(r.states_per_sec, 1))
+                       states_per_sec=round(r.states_per_sec, 1),
+                       # the run's final duplicate rate — same formula
+                       # as the segment stream's dedup_hit_rate
+                       # (obs ProgressTracker.record), so result records
+                       # stop under-reporting it as absent/0.0
+                       dedup_hit_rate=round(
+                           1.0 - r.n_states / max(1, r.n_transitions),
+                           4))
             if r.violation is not None:
                 rec["violation"] = r.violation.invariant
         say(f"[{job.job_id}] {rec['status']}: "

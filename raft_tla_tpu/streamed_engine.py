@@ -137,7 +137,7 @@ def _build_segment(config: CheckConfig, caps: StreamedCapacities, A: int,
         fvalid = valid.reshape(-1)
         tbl_hi, tbl_lo, is_new, pfail = _dedup_insert(
             tbl_hi, tbl_lo, fhi, flo, fvalid)
-        fail = fail | pfail * FAIL_PROBE
+        fail = fail | jnp.any(pfail) * FAIL_PROBE
 
         pos = n_states + jnp.cumsum(is_new.astype(I32)) - 1
         n_new = jnp.sum(is_new.astype(I32))

@@ -145,7 +145,8 @@ def test_validate_rejects_unknowns_and_type_drift():
     assert validate_event({**ok, "v": 6}) == []             # v6 superset
     assert validate_event({**ok, "v": 7}) == []             # v7 superset
     assert validate_event({**ok, "v": 8}) == []             # v8 superset
-    assert validate_event({**ok, "v": 9})                   # future version
+    assert validate_event({**ok, "v": 9}) == []             # v9 superset
+    assert validate_event({**ok, "v": 10})                  # future version
     assert validate_event({"v": 1, "event": "level_end", "ts": 0.0,
                            "level": 3})                     # missing field
 
@@ -273,6 +274,28 @@ def test_validate_v8_span_events():
     errs = validate_event({**start, "v": 7})  # v8-only fields, v7 line
     assert errs and all("requires schema version >= 8" in e for e in errs)
     assert validate_event({**start, "anchor": [1.0]})     # type drift
+
+
+def test_validate_v9_devdedup_segment_fields():
+    """The ddd device-dedup attribution (``export_rows`` /
+    ``dev_dedup_hits`` on segment events) exists only from schema v9 —
+    field-gated exactly like the v5 ``flush_backlog``, so a v8 consumer
+    never sees it."""
+    seg = {"v": 9, "event": "segment", "ts": 0.0, "wall_s": 0.1,
+           "n_states": 10, "level": 1, "n_transitions": 20,
+           "dedup_hit_rate": 0.5, "since_resume": False,
+           "states_per_sec": 100.0, "inc_states_per_sec": 100.0,
+           "export_rows": 8, "dev_dedup_hits": 2}
+    assert validate_event(seg) == []
+    # the off arm of an A/B emits export_rows without dev_dedup_hits
+    off = dict(seg)
+    del off["dev_dedup_hits"]
+    assert validate_event(off) == []
+    errs = validate_event({**seg, "v": 8})   # v9-only fields, v8 line
+    assert errs and all("requires schema version >= 9" in e
+                        for e in errs)
+    assert validate_event({**seg, "export_rows": 0.5})     # type drift
+    assert validate_event({**seg, "dev_dedup_hits": True})  # bool ≠ int
 
 
 def test_monitor_pool_attribution_rows(tmp_path):

@@ -167,6 +167,32 @@ off_line="$(grep '^3014 distinct states found' "$SERVE_TMP/prefetch_off.out" \
          echo "  on:  $on_line"; echo "  off: $off_line"; exit 1; }
 echo "prefetch smoke ok: on/off byte-identical ($on_line)"
 
+echo "== device-dedup smoke (ddd engine, HBM within-level exact set, CPU) =="
+# Gate forced ON (hash backend): the toy cfg runs end-to-end through
+# the ddd engine with the device-resident within-level fingerprint set
+# filtering segment exports, then again with the gate OFF — the result
+# lines (counts, diameter, transitions; wall stripped) must be
+# byte-identical (the widening contract: the set only drops rows the
+# host master keyset would reject anyway).
+python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
+    --spec election --max-term 2 --max-log 0 --max-msgs 2 \
+    --engine ddd --chunk 32 --device-dedup on --cpu --no-lint --no-trace \
+    | tee "$SERVE_TMP/devdedup_on.out" | tail -2
+grep -q "^3014 distinct states found" "$SERVE_TMP/devdedup_on.out" \
+    || { echo "device-dedup smoke FAILED: expected 3014 states"; exit 1; }
+python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
+    --spec election --max-term 2 --max-log 0 --max-msgs 2 \
+    --engine ddd --chunk 32 --device-dedup off --cpu --no-lint --no-trace \
+    > "$SERVE_TMP/devdedup_off.out"
+on_line="$(grep '^3014 distinct states found' "$SERVE_TMP/devdedup_on.out" \
+    | sed 's/, [0-9.]*s.*//')"
+off_line="$(grep '^3014 distinct states found' "$SERVE_TMP/devdedup_off.out" \
+    | sed 's/, [0-9.]*s.*//')"
+[ "$on_line" = "$off_line" ] \
+    || { echo "device-dedup smoke FAILED: on/off result lines differ"; \
+         echo "  on:  $on_line"; echo "  off: $off_line"; exit 1; }
+echo "device-dedup smoke ok: on/off byte-identical ($on_line)"
+
 echo "== trace smoke (v8 spans -> collect -> Perfetto -> report, CPU) =="
 # Tracing forced ON: the toy cfg runs through the ddd engine with span
 # emission into the event log, the trace CLI must collect, export and
