@@ -1,11 +1,11 @@
-"""speclint CLI — run the three analysis passes over a model config.
+"""speclint CLI — run the five analysis passes over a model config.
 
 ::
 
     python -m raft_tla_tpu.lint runs/MC3s2v.cfg            # both modes
     python -m raft_tla_tpu.lint runs/MC3s2v.cfg --strict   # warnings fail
     python -m raft_tla_tpu.lint --mode faithful --spec election cfg
-    python -m raft_tla_tpu.lint                            # no cfg: passes 1+3
+    python -m raft_tla_tpu.lint                  # no cfg: passes 1+3+4+5
 
 (``python -m raft_tla_tpu.analysis`` is the same program.)
 
@@ -28,8 +28,11 @@ def build_argparser() -> argparse.ArgumentParser:
         description="static width-safety and spec-consistency analyzer: "
                     "proves the packed encodings cannot silently truncate "
                     "(Pass 1), lints the cfg against the model registries "
-                    "(Pass 2), and flags tracer-hostile idioms in the "
-                    "kernel/engine sources (Pass 3)")
+                    "(Pass 2), flags tracer-hostile idioms in the "
+                    "kernel/engine sources (Pass 3), detects unguarded "
+                    "shared state across thread entry points (Pass 4), "
+                    "and cross-checks the gate/obs-schema/waiver "
+                    "contracts (Pass 5)")
     p.add_argument("cfg", nargs="?", default=None,
                    help="TLC model config (.cfg); omit to run only the "
                         "width and jit passes on default bounds")
@@ -49,7 +52,7 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--max-msgs", type=int, default=None, metavar="N")
     p.add_argument("--max-dup", type=int, default=None, metavar="N")
     p.add_argument("--skip", action="append", default=[],
-                   choices=("width", "cfg", "jit"),
+                   choices=("width", "cfg", "jit", "thread", "contract"),
                    help="skip a pass (repeatable)")
     return p
 
@@ -69,7 +72,8 @@ def _bounds_for(args, cfg, history: bool):
 
 def run_lint(args) -> tuple[list, int]:
     """All requested passes; returns (findings, exit_code)."""
-    from raft_tla_tpu.analysis import cfglint, jitlint, widthcheck
+    from raft_tla_tpu.analysis import (cfglint, contracts, jitlint,
+                                       threadlint, widthcheck)
     from raft_tla_tpu.utils.cfgparse import load_cfg
 
     cfg = None
@@ -102,6 +106,10 @@ def run_lint(args) -> tuple[list, int]:
                 findings.append(_tagged(f, tag))
     if "jit" not in args.skip:
         findings += jitlint.lint_paths()
+    if "thread" not in args.skip:
+        findings += threadlint.lint_paths()
+    if "contract" not in args.skip:
+        findings += contracts.lint_paths()
     return findings, report.exit_code(findings, strict=args.strict)
 
 

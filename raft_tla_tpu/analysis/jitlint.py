@@ -36,27 +36,11 @@ from raft_tla_tpu.analysis.report import JIT, WARNING, Finding
 
 WAIVER = "lint: jit-ok"
 
-# Default scan set: the kernel layer and every engine (the jit surface).
-DEFAULT_TARGETS = (
-    "raft_tla_tpu/ops",
-    "raft_tla_tpu/engine.py",
-    "raft_tla_tpu/device_engine.py",
-    "raft_tla_tpu/paged_engine.py",
-    "raft_tla_tpu/streamed_engine.py",
-    "raft_tla_tpu/ddd_engine.py",
-    "raft_tla_tpu/parallel",
-    "raft_tla_tpu/obs",
-    "raft_tla_tpu/serve",
-    "raft_tla_tpu/campaign",
-    "raft_tla_tpu/frontend",
-    "raft_tla_tpu/fleet",
-    "raft_tla_tpu/simulate.py",
-    # host-dedup layer: pure NumPy/threading, but it runs interleaved
-    # with the jit harvest loop — keep it under the same hazard lint
-    "raft_tla_tpu/utils/keyset.py",
-    "raft_tla_tpu/utils/flushq.py",
-    "raft_tla_tpu/utils/prefetch.py",
-)
+# Default scan set: the whole package.  This used to be a hand-curated
+# list of "the jit surface" that new modules had to remember to join;
+# every module is in scope now and tests/test_lint.py asserts the walk
+# misses nothing (covered_files vs an independent os.walk).
+DEFAULT_TARGETS = ("raft_tla_tpu",)
 
 _NARROW_DTYPES = {"int8", "int16", "uint8", "uint16", "bfloat16", "float16",
                   "bool_"}
@@ -249,26 +233,41 @@ def lint_source(src: str, path: str = "<string>") -> list:
     return v.findings
 
 
+def _default_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def covered_files(targets=DEFAULT_TARGETS,
+                  root: str | None = None) -> list:
+    """Absolute paths the targets resolve to — the lint's actual scan
+    set, so coverage can be asserted rather than assumed."""
+    if root is None:
+        root = _default_root()
+    files = []
+    for target in targets:
+        full = os.path.join(root, target)
+        if os.path.isfile(full):
+            files.append(full)
+        elif os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                files += [os.path.join(dirpath, f)
+                          for f in sorted(filenames)
+                          if f.endswith(".py")]
+    return sorted(set(files))
+
+
 def lint_paths(targets=DEFAULT_TARGETS, root: str | None = None) -> list:
     """Lint every .py under the target files/dirs (relative to repo
     root, resolved against this package's parent by default)."""
     if root is None:
-        root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
+        root = _default_root()
     findings = []
-    for target in targets:
-        full = os.path.join(root, target)
-        if os.path.isfile(full):
-            files = [full]
-        elif os.path.isdir(full):
-            files = sorted(
-                os.path.join(full, f) for f in os.listdir(full)
-                if f.endswith(".py"))
-        else:
-            continue
-        for path in files:
-            with open(path, "r", encoding="utf-8") as f:
-                src = f.read()
-            rel = os.path.relpath(path, root)
-            findings += lint_source(src, rel)
+    for path in covered_files(targets, root):
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        rel = os.path.relpath(path, root)
+        findings += lint_source(src, rel)
     return findings

@@ -1,4 +1,4 @@
-"""Findings — the one result type all three analysis passes emit.
+"""Findings — the one result type all five analysis passes emit.
 
 A finding is a *claim about the model or its sources*, not a runtime
 event: severity ``error`` means the pass could not prove the property it
@@ -21,6 +21,8 @@ WARNING = "warning"
 WIDTH = "width"      # Pass 1: interval width-safety
 CFG = "cfg"          # Pass 2: spec/config lint
 JIT = "jit"          # Pass 3: tracer-hazard AST lint
+THREAD = "thread"    # Pass 4: static race detector (host threading seams)
+CONTRACT = "contract"  # Pass 5: runtime-contract lint (gates, obs schema)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -32,7 +34,7 @@ class Finding:
     all four); ``file``/``line`` locate Pass 2/3 findings in sources.
     """
 
-    pass_: str                      # WIDTH | CFG | JIT
+    pass_: str                      # WIDTH | CFG | JIT | THREAD | CONTRACT
     severity: str                   # ERROR | WARNING
     code: str                       # stable kebab-case id, e.g. "width-overflow"
     message: str

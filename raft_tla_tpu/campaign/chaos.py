@@ -63,6 +63,7 @@ class ChaosMonkey:
                  truncations: dict | None = None):
         self.kills = dict(kills or {})
         self.truncations = dict(truncations or {})
+        self._lock = threading.Lock()    # guards `fired` (stalker threads)
         self.fired: list = []            # (attempt, kind, nth)
         self.observed: list = []         # (attempt, n_states, kind)
         self.truncated: list = []        # (attempt, path, new_size)
@@ -123,7 +124,8 @@ class ChaosMonkey:
                     proc.kill()
                 except ProcessLookupError:
                     pass
-                self.fired.append((attempt, kind, seen))
+                with self._lock:
+                    self.fired.append((attempt, kind, seen))
                 return
             time.sleep(0.02)
 

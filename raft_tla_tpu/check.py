@@ -206,6 +206,14 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="quotient the state space by Server permutation "
                         "symmetry (TLC SYMMETRY analog; also enabled by a "
                         "cfg SYMMETRY stanza)")
+    p.add_argument("--prescan", default=None,
+                   choices=("auto", "on", "off"),
+                   help="device-side duplicate prescan of candidate blocks "
+                        "before the host sees them (ops/kernels."
+                        "_prescan_enabled). Sets RAFT_TLA_PRESCAN "
+                        "process-wide so every engine inherits one "
+                        "decision; default: leave the env/auto policy "
+                        "alone")
     p.add_argument("--sig-prune", default=None,
                    choices=("auto", "on", "off"),
                    help="signature-refinement orbit-scan pruning: scan one "
@@ -648,10 +656,15 @@ def _finish_run(args, p, config, props, model, b) -> int:
 def main(argv=None) -> int:
     p = build_argparser()
     args = p.parse_args(argv)
-    if args.sig_prune is not None:
+    if args.prescan is not None:
         # Process-wide, BEFORE any step build: the gate is read at step-
-        # construction time (ops/kernels._sigprune_enabled), and liveness
+        # construction time (ops/kernels._prescan_enabled), and liveness
         # re-runs build engines of their own.
+        import os
+        os.environ["RAFT_TLA_PRESCAN"] = args.prescan
+    if args.sig_prune is not None:
+        # Same contract as --prescan: resolved at step-construction time
+        # (ops/kernels._sigprune_enabled) by every engine family.
         import os
         os.environ["RAFT_TLA_SIGPRUNE"] = args.sig_prune
     if args.megakernel is not None:
@@ -714,8 +727,8 @@ def main(argv=None) -> int:
         p.error(f"--events/--phase-timers/--trace require a device-class "
                 f"engine (got {args.engine}); other engines emit no run "
                 "events")
-    if args.trace and not (args.events or os.environ.get(
-            "RAFT_TLA_EVENTS")):
+    from raft_tla_tpu.obs.events import events_path
+    if args.trace and not events_path(args.events):
         p.error("--trace requires --events PATH (spans are rows in the "
                 "run-event log; without a log there is nowhere to put "
                 "them)")

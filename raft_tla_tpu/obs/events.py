@@ -160,6 +160,15 @@ _VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)  # versions validate_event accepts
 # liveness re-runs and bench children the same way RAFT_TLA_SIGPRUNE is).
 ENV_EVENTS = "RAFT_TLA_EVENTS"
 
+
+def events_path(explicit: str | None = None) -> str | None:
+    """The one resolution point for the EVENTS gate: an explicit path
+    wins, else ``RAFT_TLA_EVENTS``, else None (telemetry off).  Every
+    consumer (RunTelemetry, check.py's --trace validation) goes through
+    here so the precedence can never fork."""
+    return explicit or os.environ.get(ENV_EVENTS) or None
+
+
 _DEADLOCK_NAME = "Deadlock"  # engine.DEADLOCK's invariant name (avoid import)
 
 
@@ -604,7 +613,7 @@ class RunTelemetry:
         self.caps = caps
         self.on_progress = on_progress
         self.resumed = resumed
-        path = events or os.environ.get(ENV_EVENTS) or None
+        path = events_path(events)
         self.log = EventLog(path) if path else None
         # Spans need a sink: tracing stays NULL (the off path) without a
         # log even when the gate is on, preserving `active`'s contract.

@@ -1,0 +1,290 @@
+"""Pass 5 (contracts) — planted contract-break suite and clean-tree
+assertions.
+
+Everything runs against injectable ``contracts.Inputs`` fixtures: a
+minimal fully-wired gate (env alias, resolver, CLI flag, smoke line,
+README line, digest-free) plus a tiny obs schema and two emitters.
+Each planted bug is a single-edit mutation of that clean base, and the
+clean base itself must produce zero findings (0 FP) so every finding in
+the mutation tests is attributable to the planted edit (0 FN).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from raft_tla_tpu.analysis import contracts
+from raft_tla_tpu.analysis.report import CONTRACT, ERROR
+
+pytestmark = pytest.mark.smoke
+
+
+GATE_MOD = '''
+import os
+
+ENV_FROBBLE = "RAFT_TLA_FROBBLE"
+
+def frobble_enabled(explicit=None):
+    """The one resolution point for the FROBBLE gate."""
+    return explicit or os.environ.get(ENV_FROBBLE) or None
+
+def add_args(p):
+    p.add_argument("--frobble", choices=("auto", "on", "off"),
+                   help="sets RAFT_TLA_FROBBLE for the whole run")
+'''
+
+SCHEMA_MOD = '''
+_BASE = {"v": int, "event": str, "ts": float}
+
+_SEGMENT_REQUIRED = {"states": int}
+
+_REQUIRED = {
+    "run-start": {"spec": str},
+    "segment": _SEGMENT_REQUIRED,
+}
+
+_OPTIONAL = {
+    "segment": {"wall_s": float},
+}
+
+SCHEMA_VERSION = 1
+'''
+
+EMIT_MOD = '''
+def emit_run(path, append_event):
+    append_event(path, "run-start", spec="full")
+
+def emit_seg(tel):
+    tel.emit("segment", states=3, wall_s=0.5)
+'''
+
+DIGEST_MOD = '''
+import hashlib
+
+def config_digest(config, caps, init_key):
+    blob = repr((config, caps, init_key)).encode()
+    return hashlib.sha256(blob).hexdigest()
+'''
+
+README = ("The `--frobble` flag (env `RAFT_TLA_FROBBLE`) toggles "
+          "frobbling for the run.\n")
+
+LINT_SH = "python -m raft_tla_tpu.check --frobble on runs/toy.cfg\n"
+
+
+def _inputs(sources=None, readme=README, lint_sh=LINT_SH):
+    base = {
+        "gates.py": GATE_MOD,
+        "emit.py": EMIT_MOD,
+        "obs_events.py": SCHEMA_MOD,
+        "ckpt.py": DIGEST_MOD,
+    }
+    if sources:
+        base.update(sources)
+    return contracts.Inputs(sources=base, readme=readme, lint_sh=lint_sh,
+                            schema_path="obs_events.py",
+                            digest_path="ckpt.py")
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+def test_clean_base_no_findings():
+    assert contracts.lint_inputs(_inputs()) == []
+
+
+# -- gate contract: planted breaks, one leg at a time ------------------------
+
+def test_gate_no_cli_flag():
+    mod = GATE_MOD.replace(
+        '''def add_args(p):
+    p.add_argument("--frobble", choices=("auto", "on", "off"),
+                   help="sets RAFT_TLA_FROBBLE for the whole run")
+''', "")
+    findings = contracts.lint_inputs(_inputs({"gates.py": mod}))
+    assert _codes(findings) == ["gate-no-cli-flag"]
+    f = findings[0]
+    assert f.pass_ == CONTRACT and f.severity == ERROR
+    assert "RAFT_TLA_FROBBLE" in f.message
+
+
+def test_gate_no_smoke():
+    findings = contracts.lint_inputs(_inputs(lint_sh=""))
+    assert _codes(findings) == ["gate-no-smoke"]
+    assert "lint.sh" in findings[0].message
+
+
+def test_smoke_by_flag_counts():
+    # the smoke block may exercise the flag rather than the env name
+    findings = contracts.lint_inputs(_inputs(
+        lint_sh="run --frobble off x.cfg\n"))
+    assert findings == []
+
+
+def test_gate_no_readme():
+    findings = contracts.lint_inputs(_inputs(readme=""))
+    assert _codes(findings) == ["gate-no-readme"]
+
+
+def test_gate_no_resolver():
+    mod = GATE_MOD.replace(
+        "    return explicit or os.environ.get(ENV_FROBBLE) or None",
+        "    return explicit")
+    findings = contracts.lint_inputs(_inputs({"gates.py": mod}))
+    assert _codes(findings) == ["gate-no-resolver"]
+    assert "nothing reads it" in findings[0].message
+
+
+def test_gate_multiple_resolvers():
+    extra = '''
+import os
+
+def sneaky_read():
+    return os.environ.get("RAFT_TLA_FROBBLE")
+'''
+    findings = contracts.lint_inputs(_inputs({"extra.py": extra}))
+    assert _codes(findings) == ["gate-multiple-resolvers"]
+    # both resolution sites are cited
+    assert "extra.py" in findings[0].message
+    assert "gates.py" in findings[0].message
+
+
+def test_gate_in_digest():
+    mod = DIGEST_MOD.replace(
+        "    blob = repr((config, caps, init_key)).encode()",
+        '    tag = "RAFT_TLA_FROBBLE"\n'
+        "    blob = repr((config, caps, init_key, tag)).encode()")
+    findings = contracts.lint_inputs(_inputs({"ckpt.py": mod}))
+    assert _codes(findings) == ["gate-in-digest"]
+    assert "unresumable" in findings[0].message
+
+
+def test_gate_near_miss_did_you_mean():
+    typo = '''
+import os
+
+def oops():
+    return os.environ.get("RAFT_TLA_FROBLE")
+'''
+    findings = contracts.lint_inputs(_inputs({"typo.py": typo}))
+    assert _codes(findings) == ["gate-near-miss"]
+    f = findings[0]
+    assert "RAFT_TLA_FROBBLE" in f.message and "did you mean" in f.message
+    assert f.file == "typo.py"
+
+
+def test_env_subscript_read_counts_as_resolver():
+    mod = GATE_MOD.replace(
+        "    return explicit or os.environ.get(ENV_FROBBLE) or None",
+        "    return explicit or os.environ[ENV_FROBBLE]")
+    assert contracts.lint_inputs(_inputs({"gates.py": mod})) == []
+
+
+# -- obs-schema contract ------------------------------------------------------
+
+def test_obs_field_without_schema_bump():
+    mod = EMIT_MOD.replace(
+        'tel.emit("segment", states=3, wall_s=0.5)',
+        'tel.emit("segment", states=3, wall_s=0.5, queue_depth=2)')
+    findings = contracts.lint_inputs(_inputs({"emit.py": mod}))
+    assert _codes(findings) == ["obs-undeclared-field"]
+    f = findings[0]
+    assert f.field == "segment.queue_depth"
+    assert "SCHEMA_VERSION bump" in f.message
+
+
+def test_obs_unknown_event():
+    mod = EMIT_MOD + '''
+def emit_warp(path, append_event):
+    append_event(path, "warp-start", x=1)
+'''
+    findings = contracts.lint_inputs(_inputs({"emit.py": mod}))
+    assert _codes(findings) == ["obs-unknown-event"]
+    assert findings[0].field == "warp-start"
+
+
+def test_obs_splat_is_runtime_territory():
+    # **fields splats are validate_event's job, not the static pass's
+    mod = EMIT_MOD + '''
+def emit_any(tel, fields):
+    tel.emit("segment", **fields)
+'''
+    assert contracts.lint_inputs(_inputs({"emit.py": mod})) == []
+
+
+def test_parse_schema_resolves_named_tables():
+    allowed, events = contracts.parse_schema(SCHEMA_MOD)
+    assert events == {"run-start", "segment"}
+    # _SEGMENT_REQUIRED indirection resolved, _BASE unioned in
+    assert allowed["segment"] == {"v", "event", "ts", "states", "wall_s"}
+    assert allowed["run-start"] == {"v", "event", "ts", "spec"}
+
+
+# -- waiver audit -------------------------------------------------------------
+
+def test_stale_jit_waiver():
+    mod = "def f():\n    x = 1  # lint: jit-ok long gone\n    return x\n"
+    findings = contracts.lint_inputs(_inputs({"w.py": mod}))
+    assert _codes(findings) == ["stale-waiver"]
+    assert "jit-ok" in findings[0].message
+
+
+def test_live_jit_waiver_is_kept():
+    mod = '''
+import jax.numpy as jnp
+
+def f(x):
+    if x[0] > 0:  # lint: jit-ok planted hazard for the waiver audit
+        return jnp.sum(x)
+    return x
+'''
+    assert contracts.lint_inputs(_inputs({"w.py": mod})) == []
+
+
+def test_stale_thread_waiver():
+    mod = ("def f():\n"
+           "    y = 2  # lint: thread-ok nothing races here anymore\n"
+           "    return y\n")
+    findings = contracts.lint_inputs(_inputs({"w.py": mod}))
+    assert _codes(findings) == ["stale-waiver"]
+    assert "thread-ok" in findings[0].message
+
+
+def test_live_thread_waiver_is_kept():
+    mod = '''
+import threading
+
+class W:
+    def __init__(self):
+        self.flag = False
+        t = threading.Thread(target=self.run, daemon=True)
+        t.start()
+
+    def run(self):
+        self.flag = True  # lint: thread-ok benign one-way latch
+
+    def done(self):
+        return self.flag
+'''
+    assert contracts.lint_inputs(_inputs({"w.py": mod})) == []
+
+
+def test_waiver_unknown_kind():
+    mod = "def f():\n    x = 1  # lint: threads-ok typo'd kind\n"
+    findings = contracts.lint_inputs(_inputs({"w.py": mod}))
+    assert _codes(findings) == ["waiver-unknown-kind"]
+    assert "threads-ok" in findings[0].message
+
+
+def test_docstring_mention_is_not_a_waiver():
+    mod = '\'\'\'This module documents the `# lint: jit-ok` syntax.\'\'\'\n'
+    assert contracts.lint_inputs(_inputs({"w.py": mod})) == []
+
+
+# -- the whole tree -----------------------------------------------------------
+
+def test_contracts_repo_is_clean():
+    """Every gate fully wired, every emission in schema, every waiver
+    live — the pass gates the tree."""
+    assert contracts.lint_paths() == []

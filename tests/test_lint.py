@@ -249,6 +249,24 @@ def test_jitlint_repo_is_clean():
     assert jitlint.lint_paths() == []
 
 
+def test_jitlint_default_targets_cover_whole_package():
+    """DEFAULT_TARGETS is derived from a package walk, not a curated
+    list — an independent os.walk must find nothing the lint misses, so
+    a new module can never silently sit outside the scan set."""
+    import os
+    covered = set(jitlint.covered_files())
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        jitlint.__file__)))
+    expected = set()
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        expected |= {os.path.join(dirpath, f) for f in filenames
+                     if f.endswith(".py")}
+    assert expected, "package walk found nothing — wrong root?"
+    missed = expected - covered
+    assert not missed, f"modules outside the jit lint: {sorted(missed)}"
+
+
 # -- CLI ----------------------------------------------------------------------
 
 def test_lint_cli_flagship_exits_zero():
@@ -268,6 +286,15 @@ def test_lint_cli_inprocess_modes():
         args = build_argparser().parse_args([FLAGSHIP] + extra)
         findings, code = run_lint(args)
         assert findings == [] and code == 0, (extra, findings)
+
+
+def test_lint_cli_skip_covers_all_five_passes():
+    from raft_tla_tpu.lint import build_argparser, run_lint
+    args = build_argparser().parse_args(
+        [FLAGSHIP, "--skip", "width", "--skip", "cfg", "--skip", "jit",
+         "--skip", "thread", "--skip", "contract"])
+    findings, code = run_lint(args)
+    assert findings == [] and code == 0
 
 
 def test_lint_cli_bad_cfg_fails():

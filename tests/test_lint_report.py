@@ -1,0 +1,105 @@
+"""report.py — rendering, counts, and exit-code policy.
+
+The Finding type is the one contract all five passes share, so its
+formatting and the error/warning exit split get their own tests: every
+pass's output goes through format()/render(), and the CLI's exit code
+is exactly exit_code(findings, strict).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from raft_tla_tpu.analysis.report import (
+    CFG, CONTRACT, ERROR, JIT, THREAD, WARNING, WIDTH, Finding,
+    exit_code, has_errors, render)
+
+pytestmark = pytest.mark.smoke
+
+
+def test_pass_ids_are_stable():
+    # waiver lists and the CLI's --skip choices key off these strings
+    assert (WIDTH, CFG, JIT, THREAD, CONTRACT) == (
+        "width", "cfg", "jit", "thread", "contract")
+
+
+def test_format_width_proof_fields():
+    f = Finding(WIDTH, ERROR, "width-overflow", "votes can exceed field",
+                transition="HandleRequestVoteResponse", field="votes",
+                interval=(0, 9), width=3)
+    txt = f.format()
+    assert txt.startswith("error[width-overflow]: votes can exceed field")
+    # the acceptance contract: all four proof obligations in one line
+    assert "transition=HandleRequestVoteResponse" in txt
+    assert "field=votes" in txt
+    assert "interval=[0, 9]" in txt
+    assert "width=3" in txt
+
+
+def test_format_source_location():
+    f = Finding(THREAD, ERROR, "unguarded-shared-mutation", "race",
+                file="raft_tla_tpu/obs/phases.py", line=42)
+    assert f.format() == ("raft_tla_tpu/obs/phases.py:42: "
+                          "error[unguarded-shared-mutation]: race")
+
+
+def test_format_file_without_line():
+    f = Finding(CONTRACT, ERROR, "gate-in-digest", "gate leaked",
+                file="raft_tla_tpu/utils/ckpt.py")
+    assert f.format().startswith("raft_tla_tpu/utils/ckpt.py: error")
+
+
+def test_format_no_location_no_context():
+    f = Finding(CFG, WARNING, "vacuous-invariant", "always true")
+    assert f.format() == "warning[vacuous-invariant]: always true"
+    assert "(" not in f.format()
+
+
+def test_render_counts_and_header():
+    findings = [
+        Finding(JIT, WARNING, "traced-python-if", "hazard", file="a.py",
+                line=1),
+        Finding(THREAD, ERROR, "unguarded-shared-mutation", "race",
+                file="b.py", line=2),
+        Finding(CONTRACT, ERROR, "gate-no-smoke", "unwired gate"),
+    ]
+    out = render(findings, header="speclint: toy.cfg")
+    lines = out.splitlines()
+    assert lines[0] == "speclint: toy.cfg"
+    assert len(lines) == 5                      # header + 3 findings + tally
+    assert lines[-1] == "2 error(s), 1 warning(s)"
+
+
+def test_render_empty_is_just_the_tally():
+    assert render([]) == "0 error(s), 0 warning(s)"
+    assert render([], header="h") == "h\n0 error(s), 0 warning(s)"
+
+
+def test_has_errors():
+    warn = Finding(JIT, WARNING, "set-iteration", "w")
+    err = Finding(WIDTH, ERROR, "width-overflow", "e")
+    assert not has_errors([])
+    assert not has_errors([warn])
+    assert has_errors([warn, err])
+
+
+def test_exit_code_policy():
+    warn = Finding(JIT, WARNING, "set-iteration", "w")
+    err = Finding(CONTRACT, ERROR, "stale-waiver", "e")
+    # errors always fail
+    assert exit_code([err]) == 1
+    assert exit_code([err], strict=True) == 1
+    # warnings fail only under --strict
+    assert exit_code([warn]) == 0
+    assert exit_code([warn], strict=True) == 1
+    # clean is clean either way
+    assert exit_code([]) == 0
+    assert exit_code([], strict=True) == 0
+
+
+def test_findings_are_frozen_and_hashable():
+    # passes dedupe and set-ify findings; the dataclass must stay frozen
+    f = Finding(CFG, ERROR, "unknown-name", "x")
+    with pytest.raises(Exception):
+        f.severity = WARNING
+    assert len({f, Finding(CFG, ERROR, "unknown-name", "x")}) == 1

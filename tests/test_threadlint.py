@@ -1,0 +1,469 @@
+"""Pass 4 (threadlint) — planted-race suite and clean-tree assertions.
+
+Mirrors test_lint_mutations.py's discipline for the race detector: every
+planted bug class must be caught (0 false negatives), and the matching
+disciplined shape must NOT be flagged (0 false positives), so the pass
+can gate the tree without crying wolf.  The centerpiece is the PR 17
+phases.py off-owner race: the exact pre-fix shape (a worker-thread
+``__exit__`` mutating the timers' dict through a local alias, no lock)
+must produce a finding, and the shipped post-fix shape must not.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from raft_tla_tpu.analysis import threadlint
+from raft_tla_tpu.analysis.report import ERROR, THREAD
+
+pytestmark = pytest.mark.smoke
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+def _lint(src):
+    return threadlint.lint_source(src, "planted.py")
+
+
+# a minimal spawning worker used by several mutations; SAFE as written:
+# everything shared is either lock-guarded or published before spawn
+SAFE_WORKER = '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = []
+        self._done = 0
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+                self._done += 1
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+'''
+
+
+def test_clean_worker_no_findings():
+    assert _lint(SAFE_WORKER) == []
+
+
+# -- bug class 1: dropped lock ------------------------------------------------
+
+def test_dropped_lock_is_caught():
+    mutated = SAFE_WORKER.replace(
+        "        with self._lock:\n"
+        "            self._closed = True",
+        "        self._closed = True")
+    findings = _lint(mutated)
+    assert _codes(findings) == ["unguarded-shared-mutation"]
+    f = findings[0]
+    assert f.pass_ == THREAD and f.severity == ERROR
+    assert "Worker._closed" in f.message
+    # both access sites cited: the mutation location + the other side
+    assert f.line is not None and "planted.py:" in f.message
+
+
+def test_dropped_lock_worker_side_is_caught():
+    mutated = SAFE_WORKER.replace(
+        "            with self._lock:\n"
+        "                if self._closed:\n"
+        "                    return\n"
+        "                self._done += 1",
+        "            if self._closed:\n"
+        "                return\n"
+        "            self._done += 1")
+    findings = _lint(mutated)
+    assert "unguarded-shared-mutation" in _codes(findings)
+
+
+# -- bug class 2: post-spawn publish -----------------------------------------
+
+def test_post_spawn_publish_is_caught():
+    src = SAFE_WORKER.replace(
+        "        self._closed = False\n"
+        "        self._thread = threading.Thread",
+        "        self._thread = threading.Thread")
+    src = src.replace(
+        "        self._thread.start()",
+        "        self._thread.start()\n"
+        "        self._closed = False")
+    findings = _lint(src)
+    assert _codes(findings) == ["post-spawn-publish"]
+    assert "spawn" in findings[0].message
+
+
+def test_publish_before_spawn_is_clean():
+    # ctor writes above the Thread(...) line are the main thread's half
+    # of the handshake — never flagged
+    assert _lint(SAFE_WORKER) == []
+
+
+# -- bug class 3: the PR 17 off-owner alias race ------------------------------
+
+# the exact pre-fix obs/phases.py shape: _Phase.__exit__ runs on
+# whatever thread executes the `with timers.phase(...)` block and
+# mutates the owner's dict through a local alias, with no lock anywhere
+PRE_FIX_PHASES = '''
+import threading, time
+
+class PhaseTimers:
+    def __init__(self, enabled=True):
+        self.enabled = enabled
+        self._acc = {}
+        self._owner = threading.get_ident()
+
+    def phase(self, name):
+        return _Phase(self, name)
+
+    def snapshot(self):
+        out = dict(self._acc)
+        self._acc = {}
+        return out
+
+class _Phase:
+    def __init__(self, timers, name):
+        self._timers = timers
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        acc = self._timers._acc
+        acc[self._name] = acc.get(self._name, 0.0) + (
+            time.monotonic() - self._t0)
+        return False
+
+class FlushWorker:
+    def __init__(self):
+        self._phases = PhaseTimers()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._phases.phase("dedup"):
+                pass
+'''
+
+
+def test_pr17_off_owner_race_is_caught():
+    findings = _lint(PRE_FIX_PHASES)
+    assert findings, "the PR 17 pre-fix shape must be a finding"
+    assert all(f.code == "unguarded-shared-mutation" for f in findings)
+    assert any("PhaseTimers._acc" in f.message for f in findings)
+    # the alias-mutation line inside __exit__ is one of the cited sites
+    exit_mutation = [f for f in findings if f.line in (29, 30)]
+    assert exit_mutation, [f.line for f in findings]
+
+
+def test_pr17_post_fix_shape_is_clean():
+    # the shipped fix: PhaseTimers grows a lock, __exit__ and snapshot
+    # both take it
+    fixed = PRE_FIX_PHASES.replace(
+        "        self._acc = {}\n"
+        "        self._owner",
+        "        self._acc = {}\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._owner")
+    fixed = fixed.replace(
+        "        acc = self._timers._acc\n"
+        "        acc[self._name] = acc.get(self._name, 0.0) + (\n"
+        "            time.monotonic() - self._t0)",
+        "        timers = self._timers\n"
+        "        with timers._lock:\n"
+        "            acc = timers._acc\n"
+        "            acc[self._name] = acc.get(self._name, 0.0) + (\n"
+        "                time.monotonic() - self._t0)")
+    fixed = fixed.replace(
+        "        out = dict(self._acc)\n"
+        "        self._acc = {}\n"
+        "        return out",
+        "        with self._lock:\n"
+        "            out = dict(self._acc)\n"
+        "            self._acc = {}\n"
+        "        return out")
+    assert _lint(fixed) == []
+
+
+def test_real_phases_module_is_clean():
+    import os
+    import raft_tla_tpu.obs.phases as phases_mod
+    path = phases_mod.__file__
+    with open(path) as fh:
+        src = fh.read()
+    # self-contained module lint: the shipped fix must satisfy the pass
+    assert threadlint.lint_source(src, os.path.basename(path)) == []
+
+
+# -- bug class 4: handoff rebound --------------------------------------------
+
+def test_handoff_rebound_is_caught():
+    src = '''
+import threading, queue
+
+class Pump:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self._q.get()
+
+    def reset(self):
+        self._q = queue.Queue()
+'''
+    findings = _lint(src)
+    assert _codes(findings) == ["handoff-rebound"]
+    assert "Pump._q" in findings[0].message
+
+
+def test_handoff_use_is_clean():
+    src = '''
+import threading, queue
+
+class Pump:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self._q.get()
+
+    def put(self, item):
+        self._q.put(item)
+'''
+    assert _lint(src) == []
+
+
+# -- bug class 5: waiver present but reason missing ---------------------------
+
+def test_waiver_without_reason_is_caught():
+    mutated = SAFE_WORKER.replace(
+        "        with self._lock:\n"
+        "            self._closed = True",
+        "        self._closed = True  # lint: thread-ok")
+    findings = _lint(mutated)
+    assert _codes(findings) == ["waiver-missing-reason"]
+
+
+def test_waiver_with_reason_suppresses():
+    mutated = SAFE_WORKER.replace(
+        "        with self._lock:\n"
+        "            self._closed = True",
+        "        self._closed = True  # lint: thread-ok benign flag, "
+        "worst case one extra loop pass")
+    findings = _lint(mutated)
+    assert findings == []
+
+
+def test_used_waiver_lines_are_exported():
+    mutated = SAFE_WORKER.replace(
+        "        with self._lock:\n"
+        "            self._closed = True",
+        "        self._closed = True  # lint: thread-ok benign")
+    res = threadlint.analyze({"planted.py": mutated})
+    assert res.findings == []
+    assert any(line for (path, line) in res.used_waivers
+               if path == "planted.py")
+
+
+# -- bug class 6: unguarded module-global from a thread -----------------------
+
+def test_global_mutation_race_is_caught():
+    src = '''
+import threading
+
+COUNTS = {}
+
+def worker():
+    COUNTS["n"] = COUNTS.get("n", 0) + 1
+
+def start():
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    COUNTS["m"] = 0
+'''
+    findings = _lint(src)
+    assert "unguarded-shared-mutation" in _codes(findings)
+    assert any("COUNTS" in f.message for f in findings)
+
+
+def test_global_behind_lock_is_clean():
+    src = '''
+import threading
+
+_LOCK = threading.Lock()
+COUNTS = {}
+
+def worker():
+    with _LOCK:
+        COUNTS["n"] = COUNTS.get("n", 0) + 1
+
+def start():
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    with _LOCK:
+        COUNTS["m"] = 0
+'''
+    assert _lint(src) == []
+
+
+# -- bug class 7: executor submit target races --------------------------------
+
+def test_executor_submit_race_is_caught():
+    src = '''
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+class Batcher:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(2)
+        self._results = []
+
+    def _job(self, x):
+        self._results.append(x)
+
+    def submit(self, x):
+        self._pool.submit(self._job, x)
+        n = len(self._results)
+        self._results = []
+        return n
+'''
+    findings = _lint(src)
+    assert "unguarded-shared-mutation" in _codes(findings)
+    assert any("Batcher._results" in f.message for f in findings)
+
+
+# -- FP guards: the shapes the real tree relies on ---------------------------
+
+def test_caller_held_lock_propagates():
+    # the prefetch.py `_reraise_locked` convention: the helper's every
+    # call site holds the cv — the helper's own mutation is guarded
+    src = '''
+import threading
+
+class Prefetcher:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._exc = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        with self._cv:
+            self._exc = self._exc or ValueError()
+
+    def _reraise_locked(self):
+        exc, self._exc = self._exc, None
+        if exc is not None:
+            raise exc
+
+    def take(self):
+        with self._cv:
+            self._reraise_locked()
+'''
+    assert _lint(src) == []
+
+
+def test_thread_confined_handle_class_is_clean():
+    # a per-call handle class with no lock/handoff/spawn of its own and
+    # not stored in any spawning class's field stays out of scope
+    src = '''
+class Span:
+    def __init__(self, name):
+        self.name = name
+        self.dur = 0.0
+
+    def close(self, dur):
+        self.dur = dur
+
+def run_all(items):
+    spans = [Span(i) for i in items]
+    for s in spans:
+        s.close(1.0)
+    return spans
+'''
+    assert _lint(src) == []
+
+
+def test_nested_def_spawn_target_is_modeled():
+    # the serve/chaos.py shape: a nested def passed to Thread(target=...)
+    src = '''
+import threading
+
+class Stalker:
+    def __init__(self):
+        self.kills = []
+
+    def arm(self):
+        def run():
+            self.kills.append(1)
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        self.kills.append(0)
+'''
+    findings = _lint(src)
+    assert "unguarded-shared-mutation" in _codes(findings)
+
+
+# -- the whole tree -----------------------------------------------------------
+
+def test_threadlint_repo_is_clean():
+    """Every real finding is fixed or waived — the pass gates the tree."""
+    assert threadlint.lint_paths() == []
+
+
+def test_campaign_chaos_fix_regression():
+    """PR 19's real finding: ChaosMonkey._stalk appends to ``fired``
+    from a stalker thread; the fix guards it with the monkey's lock.
+    Reverting the guard must re-surface the finding."""
+    import os
+    import raft_tla_tpu.campaign.chaos as chaos_mod
+    with open(chaos_mod.__file__) as fh:
+        src = fh.read()
+    guarded = ("                with self._lock:\n"
+               "                    self.fired.append((attempt, kind, "
+               "seen))")
+    assert guarded in src, "the shipped fix changed shape; update test"
+    assert threadlint.lint_source(src, "campaign/chaos.py") == []
+    reverted = src.replace(
+        guarded,
+        "                self.fired.append((attempt, kind, seen))")
+    findings = threadlint.lint_source(reverted, "campaign/chaos.py")
+    assert any(f.code == "unguarded-shared-mutation"
+               and "fired" in f.message for f in findings)
+
+
+def test_chaosmonkey_fired_is_lock_guarded_at_runtime():
+    """Behavioral half of the regression test: concurrent recorders
+    through the shipped lock lose no entries."""
+    import threading as th
+    from raft_tla_tpu.campaign.chaos import ChaosMonkey
+    monkey = ChaosMonkey()
+    def record(a):
+        for i in range(100):
+            with monkey._lock:
+                monkey.fired.append((a, "kill", i))
+    threads = [th.Thread(target=record, args=(a,)) for a in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(monkey.fired) == 400

@@ -1,31 +1,70 @@
 #!/usr/bin/env bash
-# Pre-push gate: the speclint static analyzer plus a pytest collection
-# sanity pass.  Fast (no model checking, no kernel compiles beyond the
-# analyzer's own imports) — run it before every push:
+# Pre-push gate: the speclint static analyzer (Passes 1-5) plus smoke
+# runs of every gated subsystem.  Fast (no model checking beyond toy
+# configs, no kernel compiles beyond the analyzer's own imports) — run
+# it before every push:
 #
 #     tools/lint.sh            # both encoding modes, flagship cfg
 #     tools/lint.sh --strict   # warnings fail too
 #
 # Exits nonzero if the analyzer reports an error (or, with --strict, any
-# finding), or if the smoke-marked test set no longer collects.
+# finding), or if any smoke block fails.  Every block is named: the
+# summary table at the end shows one line per block, and a mid-script
+# failure prints "FAILED in block: <name>" so it cannot be misread.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== speclint (width + cfg + jit passes, parity & faithful) =="
+SERVE_TMP=""
+BLOCK_NAMES=()
+BLOCK_STATUS=()
+CURRENT_BLOCK=""
+
+begin() {
+    # close the previous block as ok (a failure never reaches the next
+    # begin under set -e), then open the named one
+    if [ -n "$CURRENT_BLOCK" ]; then
+        BLOCK_NAMES+=("$CURRENT_BLOCK"); BLOCK_STATUS+=("ok")
+    fi
+    CURRENT_BLOCK="$1"
+    echo "== $2 =="
+}
+
+on_exit() {
+    rc=$?
+    [ -n "$SERVE_TMP" ] && rm -rf "$SERVE_TMP"
+    if [ -n "$CURRENT_BLOCK" ]; then
+        BLOCK_NAMES+=("$CURRENT_BLOCK")
+        if [ "$rc" -eq 0 ]; then BLOCK_STATUS+=("ok")
+        else BLOCK_STATUS+=("FAIL"); fi
+    fi
+    echo
+    echo "== lint.sh summary =="
+    for ((i = 0; i < ${#BLOCK_NAMES[@]}; i++)); do
+        printf '  %-14s %s\n' "${BLOCK_NAMES[$i]}" "${BLOCK_STATUS[$i]}"
+    done
+    if [ "$rc" -ne 0 ]; then
+        echo "FAILED in block: $CURRENT_BLOCK (exit $rc)"
+    else
+        echo "all ${#BLOCK_NAMES[@]} blocks ok"
+    fi
+    exit "$rc"
+}
+trap on_exit EXIT
+
+begin speclint "speclint (width + cfg + jit + thread + contract, parity & faithful)"
 python -m raft_tla_tpu.lint runs/MC3s2v.cfg "$@"
 
-echo "== pytest smoke collection =="
+begin collect "pytest smoke collection"
 python -m pytest tests/ -m smoke --collect-only -q -p no:cacheprovider \
     --continue-on-collection-errors | tail -2
 
-echo "== obs smoke (event schema conformance) =="
+begin obs "obs smoke (event schema conformance)"
 python -m pytest tests/test_obs.py -m smoke -q -p no:cacheprovider | tail -2
 
-echo "== serve smoke (2-job toy manifest end-to-end, CPU) =="
+begin serve "serve smoke (2-job toy manifest end-to-end, CPU)"
 SERVE_TMP="$(mktemp -d)"
-trap 'rm -rf "$SERVE_TMP"' EXIT
 cat > "$SERVE_TMP/toy.cfg" <<'CFG'
 SPECIFICATION Spec
 INVARIANT NoTwoLeaders
@@ -62,7 +101,7 @@ print(f"serve smoke ok: 2 jobs x {recs[0]['n_states']} states, "
       "per-tenant event logs valid")
 PY
 
-echo "== serve daemon smoke (watch-dir intake -> SIGINT drain, CPU) =="
+begin serve-daemon "serve daemon smoke (watch-dir intake -> SIGINT drain, CPU)"
 mkdir -p "$SERVE_TMP/queue"
 python -m raft_tla_tpu.serve "$SERVE_TMP/queue" --watch \
     --out "$SERVE_TMP/dout" --chunk 64 --poll 0.2 --cpu --quiet &
@@ -86,7 +125,7 @@ assert rec["status"] == "completed" and rec["n_states"] == 524, rec
 print("serve daemon smoke ok: watch intake served, SIGINT drained clean")
 PY
 
-echo "== serve-chaos smoke (worker pool + mid-dispatch SIGKILL, CPU) =="
+begin serve-chaos "serve-chaos smoke (worker pool + mid-dispatch SIGKILL, CPU)"
 # The pool's acceptance bar in miniature: solo reference pass, then the
 # supervised worker pool with the first worker SIGKILLed after 2 segment
 # events — requeued jobs re-run losslessly and every final results
@@ -96,7 +135,7 @@ python -m raft_tla_tpu.serve.chaos "$SERVE_TMP/toy.cfg" \
     --chunk 256 --max-msgs 1 --kill-after-segments 2 --cpu --quiet \
     | tail -1
 
-echo "== frontend smoke (two-phase commit through the spec compiler, CPU) =="
+begin frontend "frontend smoke (two-phase commit through the spec compiler, CPU)"
 cat > "$SERVE_TMP/2pc.cfg" <<'CFG'
 SPECIFICATION Spec
 CONSTANT RM = {r1, r2}
@@ -108,7 +147,7 @@ python -m raft_tla_tpu.check "$SERVE_TMP/2pc.cfg" \
 grep -q "^56 distinct states found" "$SERVE_TMP/2pc.out" \
     || { echo "frontend smoke FAILED: expected 56 states"; exit 1; }
 
-echo "== megakernel smoke (toy cfg, staged whole-step Pallas, CPU) =="
+begin megakernel "megakernel smoke (toy cfg, staged whole-step Pallas, CPU)"
 # Gate forced ON: off-TPU this runs the kernel in Pallas interpret
 # mode (ops/pallas_compat.resolve), so the block walks the real
 # pallas_call staging path end-to-end inside a real engine.
@@ -119,7 +158,7 @@ python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
 grep -q "^3014 distinct states found" "$SERVE_TMP/megakernel.out" \
     || { echo "megakernel smoke FAILED: expected 3014 states"; exit 1; }
 
-echo "== host-dedup smoke (ddd engine, background partitioned flush, CPU) =="
+begin host-dedup "host-dedup smoke (ddd engine, background partitioned flush, CPU)"
 # Gate forced ON: the toy cfg runs end-to-end through the ddd engine
 # with partitioned master keys and the depth-1 background flush worker,
 # then again with the gate OFF — the result lines (counts, diameter,
@@ -143,7 +182,7 @@ off_line="$(grep '^3014 distinct states found' "$SERVE_TMP/hostdedup_off.out" \
          echo "  on:  $on_line"; echo "  off: $off_line"; exit 1; }
 echo "host-dedup smoke ok: on/off byte-identical ($on_line)"
 
-echo "== prefetch smoke (ddd engine, double-buffered upload staging, CPU) =="
+begin prefetch "prefetch smoke (ddd engine, double-buffered upload staging, CPU)"
 # Gate forced ON: the toy cfg runs end-to-end through the ddd engine
 # with block uploads served from the background prefetch thread, then
 # again with the gate OFF — the result lines (counts, diameter,
@@ -167,7 +206,7 @@ off_line="$(grep '^3014 distinct states found' "$SERVE_TMP/prefetch_off.out" \
          echo "  on:  $on_line"; echo "  off: $off_line"; exit 1; }
 echo "prefetch smoke ok: on/off byte-identical ($on_line)"
 
-echo "== device-dedup smoke (ddd engine, HBM within-level exact set, CPU) =="
+begin device-dedup "device-dedup smoke (ddd engine, HBM within-level exact set, CPU)"
 # Gate forced ON (hash backend): the toy cfg runs end-to-end through
 # the ddd engine with the device-resident within-level fingerprint set
 # filtering segment exports, then again with the gate OFF — the result
@@ -193,7 +232,37 @@ off_line="$(grep '^3014 distinct states found' "$SERVE_TMP/devdedup_off.out" \
          echo "  on:  $on_line"; echo "  off: $off_line"; exit 1; }
 echo "device-dedup smoke ok: on/off byte-identical ($on_line)"
 
-echo "== trace smoke (v8 spans -> collect -> Perfetto -> report, CPU) =="
+begin gates "gates smoke (--sig-prune/--prescan/--phase-timers/--compile-cache, CPU)"
+# The four remaining RAFT_TLA_* gates exercised in one identity check:
+# every gate forced away from its auto default (the phase-timer sync
+# path, both kernel-policy gates, the persistent compile cache), then a
+# default run — the result lines (wall stripped) must be byte-identical,
+# and the compile cache directory must actually be populated.
+python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
+    --spec election --max-term 2 --max-log 0 --max-msgs 2 \
+    --engine ddd --chunk 32 --sig-prune on --prescan on \
+    --phase-timers --compile-cache "$SERVE_TMP/jaxcache" \
+    --cpu --no-lint --no-trace \
+    | tee "$SERVE_TMP/gates_on.out" | tail -2
+grep -q "^3014 distinct states found" "$SERVE_TMP/gates_on.out" \
+    || { echo "gates smoke FAILED: expected 3014 states"; exit 1; }
+[ -d "$SERVE_TMP/jaxcache" ] && [ -n "$(ls -A "$SERVE_TMP/jaxcache")" ] \
+    || { echo "gates smoke FAILED: compile cache dir empty"; exit 1; }
+python -m raft_tla_tpu.check "$SERVE_TMP/toy.cfg" \
+    --spec election --max-term 2 --max-log 0 --max-msgs 2 \
+    --engine ddd --chunk 32 --sig-prune off --prescan off \
+    --cpu --no-lint --no-trace \
+    > "$SERVE_TMP/gates_off.out"
+on_line="$(grep '^3014 distinct states found' "$SERVE_TMP/gates_on.out" \
+    | sed 's/, [0-9.]*s.*//')"
+off_line="$(grep '^3014 distinct states found' "$SERVE_TMP/gates_off.out" \
+    | sed 's/, [0-9.]*s.*//')"
+[ "$on_line" = "$off_line" ] \
+    || { echo "gates smoke FAILED: on/off result lines differ"; \
+         echo "  on:  $on_line"; echo "  off: $off_line"; exit 1; }
+echo "gates smoke ok: on/off byte-identical ($on_line)"
+
+begin trace "trace smoke (v8 spans -> collect -> Perfetto -> report, CPU)"
 # Tracing forced ON: the toy cfg runs through the ddd engine with span
 # emission into the event log, the trace CLI must collect, export and
 # attribute it — then the same run with tracing OFF must produce a
@@ -231,7 +300,7 @@ off_line="$(grep '^3014 distinct states found' "$SERVE_TMP/trace_off.out" \
          echo "  on:  $on_line"; echo "  off: $off_line"; exit 1; }
 echo "trace smoke ok: on/off byte-identical ($on_line)"
 
-echo "== chaos smoke (campaign SIGKILL + reshard 1->2->1, CPU) =="
+begin campaign-chaos "chaos smoke (campaign SIGKILL + reshard 1->2->1, CPU)"
 # The campaign supervisor's acceptance loop in miniature: reference run,
 # then SIGKILL after the 2nd checkpoint, auto-reshard across a 1->2->1
 # virtual-mesh plan, unattended resume — finals must be identical.
@@ -241,7 +310,7 @@ python -m raft_tla_tpu.campaign.chaos "$SERVE_TMP/toy.cfg" \
     --window 128 --chunk 32 --kill-after 2 --mesh-plan 1,2,1 --cpu \
     | tail -3
 
-echo "== fleet smoke (sharded walker fleet, 2 virtual devices, CPU) =="
+begin fleet "fleet smoke (sharded walker fleet, 2 virtual devices, CPU)"
 # Deterministic seed: the same cfg at the same seed must report the same
 # behavior/state counts every run, on any mesh (the fleet's
 # device-count-invariance contract in one grep).
