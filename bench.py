@@ -619,7 +619,7 @@ def main() -> None:
               f"({r['n_states'] / r['wall_s']:,.0f} states/s)",
               file=sys.stderr)
 
-    print(json.dumps({
+    payload = {
         "metric": "symmetric_fullnext_orbits_per_sec_single_chip",
         "value": round(rate, 1),
         "unit": "orbits/s",
@@ -630,7 +630,17 @@ def main() -> None:
         "toy_suite_states_per_sec": round(total_states / total_wall, 1),
         "toy_suite_vs_60s_budget": round(60.0 / total_wall, 2),
         **fid,
-    }))
+    }
+    print(json.dumps(payload))
+    # The same payload the BENCH_r0*.json drivers record as "parsed",
+    # written through the history store when RAFT_TLA_HISTORY is set —
+    # so raft-tla-regress can verdict this round against the recorded
+    # rounds (and the old BENCH files ingest as seed history).
+    try:
+        from raft_tla_tpu.obs.history import append_bench
+        append_bench(payload, meta={"source": "bench.py"})
+    except Exception as e:          # evidence channel, never the verdict
+        print(f"bench: history append failed: {e!r}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -78,6 +78,11 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="SIGINT -> SIGKILL grace window (default 20)")
     p.add_argument("--cpu", action="store_true",
                    help="children run on the CPU backend")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="P",
+                   help="expose a live OpenMetrics endpoint on "
+                        "127.0.0.1:P (0 = ephemeral port; also via "
+                        "RAFT_TLA_METRICS) over the workdir's event "
+                        "logs, snapshotted into WORKDIR/metrics.events")
     p.add_argument("--json", action="store_true",
                    help="print the final CampaignResult as JSON")
     p.add_argument("--quiet", action="store_true")
@@ -112,7 +117,25 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGUSR1,
                   lambda *_: sup.request_preempt("preempt-signal",
                                                  "SIGUSR1"))
-    res = sup.run()
+    from raft_tla_tpu.obs.metrics import metrics_port
+    mport = metrics_port(args.metrics_port)
+    mserver = None
+    if mport is not None:
+        # Reads the campaign's own event logs (run.events /
+        # supervisor.events) from the supervising process — the child
+        # engines never see the endpoint.
+        import os
+        from raft_tla_tpu.obs.openmetrics import MetricsServer
+        os.makedirs(args.workdir, exist_ok=True)
+        mserver = MetricsServer(
+            args.workdir, port=mport,
+            snapshot_path=os.path.join(args.workdir, "metrics.events"))
+        print(f"metrics endpoint: {mserver.url}", flush=True)
+    try:
+        res = sup.run()
+    finally:
+        if mserver is not None:
+            mserver.close()
     if args.json:
         print(json.dumps(res.__dict__, sort_keys=True))
     elif not args.quiet:

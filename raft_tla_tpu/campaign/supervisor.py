@@ -59,6 +59,8 @@ from raft_tla_tpu.campaign.integrity import (CheckpointCorrupt,
                                              snapshot_family,
                                              verify_snapshot)
 from raft_tla_tpu.obs import append_event
+from raft_tla_tpu.obs.collect import LogTail as _LogTail
+from raft_tla_tpu.obs.history import _DRIFT_EXEMPT, fiducial_drift
 
 # check.py's exit contract (mirrored, not imported: the supervisor must
 # not pay the check-CLI import just to read four integers)
@@ -131,60 +133,11 @@ class CampaignResult:
     detail: str = ""
 
 
-class _LogTail:
-    """Incremental JSONL tailer: byte-offset resume, partial-line safe
-    (a half-written line stays buffered until its newline lands), and
-    truncation-aware — a log rewritten/rotated underneath us (file
-    shrank below our offset) resets the tail to the start of the new
-    content instead of reading from a stale offset forever."""
-
-    def __init__(self, path: str):
-        self.path = path
-        self._pos = 0
-        self._buf = ""
-
-    def seek_end(self) -> None:
-        try:
-            self._pos = os.path.getsize(self.path)
-        except OSError:
-            self._pos = 0
-        self._buf = ""
-
-    def poll(self) -> list:
-        try:
-            if os.path.getsize(self.path) < self._pos:
-                self._pos = 0            # truncated under us: re-anchor
-                self._buf = ""
-            with open(self.path, "r", encoding="utf-8") as f:
-                f.seek(self._pos)
-                chunk = f.read()
-                self._pos = f.tell()
-        except OSError:
-            return []
-        if not chunk:
-            return []
-        self._buf += chunk
-        out = []
-        while "\n" in self._buf:
-            line, self._buf = self._buf.split("\n", 1)
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                d = json.loads(line)
-            except ValueError:
-                continue                 # torn line: a crash mid-append
-            if isinstance(d, dict):
-                out.append(d)
-        return out
-
-
-# Fiducials excluded from the drift verdict: sub-microsecond timing
-# pins (the trace off-path cost) are too noisy for a ratio test — a
-# scheduler hiccup would read as 3x "drift" on a number measured in
-# tenths of a microsecond.  They are pinned for the A/B record, not as
-# a health signal.
-_DRIFT_EXEMPT = frozenset({"trace_emit_overhead_us"})
+# _LogTail and _DRIFT_EXEMPT began life here; they now live in
+# obs/collect.py (shared with the metrics aggregator) and
+# obs/history.py (shared with raft-tla-regress) respectively, and are
+# re-imported above so the serve/chaos tails and the pinned-sequence
+# tests keep their import sites.
 
 
 def _median(xs: list) -> float:
@@ -278,12 +231,10 @@ class HealthMonitor:
         base, cur = self.fiducial_baseline, self.fiducials_seen
         if not self.policy.drift_max or not base or not cur:
             return None
-        for key in sorted(set(base) & set(cur) - _DRIFT_EXEMPT):
-            a, b = base[key], cur[key]
-            if isinstance(a, (int, float)) and isinstance(b, (int, float)) \
-                    and a > 0 and b / a > self.policy.drift_max:
-                return key, b / a
-        return None
+        # The one drift policy (shared with raft-tla-regress): first
+        # offending key in sorted order, one-sided growth ratio,
+        # _DRIFT_EXEMPT honored.
+        return fiducial_drift(base, cur, self.policy.drift_max)
 
     def verdict(self) -> tuple | None:
         """None = healthy, else ``(reason, detail)`` with reason one of

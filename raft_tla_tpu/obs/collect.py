@@ -52,6 +52,60 @@ def find_logs(root: str) -> list:
     return sorted(found)
 
 
+class LogTail:
+    """Incremental JSONL tailer: byte-offset resume, partial-line safe
+    (a half-written line stays buffered until its newline lands), and
+    truncation-aware — a log rewritten/rotated underneath us (file
+    shrank below our offset) resets the tail to the start of the new
+    content instead of reading from a stale offset forever.
+
+    Grew up as ``campaign/supervisor._LogTail`` (the health watch);
+    now shared with the serve supervision tails and the metrics
+    aggregator's per-log reducers (obs/metrics.py), which is why it
+    lives here next to :func:`find_logs`.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._pos = 0
+        self._buf = ""
+
+    def seek_end(self) -> None:
+        try:
+            self._pos = os.path.getsize(self.path)
+        except OSError:
+            self._pos = 0
+        self._buf = ""
+
+    def poll(self) -> list:
+        try:
+            if os.path.getsize(self.path) < self._pos:
+                self._pos = 0            # truncated under us: re-anchor
+                self._buf = ""
+            with open(self.path, "r", encoding="utf-8") as f:
+                f.seek(self._pos)
+                chunk = f.read()
+                self._pos = f.tell()
+        except OSError:
+            return []
+        if not chunk:
+            return []
+        self._buf += chunk
+        out = []
+        while "\n" in self._buf:
+            line, self._buf = self._buf.split("\n", 1)
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue                 # torn line: a crash mid-append
+            if isinstance(d, dict):
+                out.append(d)
+        return out
+
+
 def _read_events(path: str) -> tuple:
     """(events, n_invalid): parsed JSONL rows with an ``event`` field.
 

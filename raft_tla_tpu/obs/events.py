@@ -25,7 +25,7 @@ wall).  This module replaces all of that with:
   ``level_end`` derived automatically from level transitions and
   ``violation`` derived from the final :class:`~raft_tla_tpu.engine.EngineResult`.
 
-Event grammar (``SCHEMA_VERSION`` = 8; earlier-version lines remain
+Event grammar (``SCHEMA_VERSION`` = 10; earlier-version lines remain
 valid) —
 every line is one JSON object with base fields ``v`` (schema version),
 ``event`` (type) and ``ts`` (unix epoch seconds):
@@ -134,13 +134,25 @@ engines regardless of the gate so A/B off arms stay comparable) and
 ``dev_dedup_hits`` (cumulative rows the device set dropped pre-export;
 only present when the gate is on).
 
+Version 10 adds the live metrics layer (obs/metrics.py — gated by
+``--metrics-port`` / ``RAFT_TLA_METRICS``, never on by default):
+
+``metrics_snapshot``  metrics [+ port, root]
+                   (one periodic snapshot of the streaming-reducer
+                    registry: a flat ``{prometheus_name: value}`` dict
+                    — counters, gauges, and the per-tenant latency
+                    histogram quantiles the OpenMetrics endpoint
+                    exposes — so the scrape record is replayable from
+                    the event log alone; ``port`` the bound endpoint
+                    port, ``root`` the swept log directory)
+
 A run log with no ``run_end`` means the process died — crash attribution
 for free.  The schema is strict: unknown fields fail validation and the
-v2/v7/v8-only event types (resp. v3/v4/v5/v6/v8/v9-only fields) are
-invalid on a ``"v" < 2`` / ``"v" < 7`` / ``"v" < 8`` (resp. ``"v" < 3``
-/ ``"v" < 4`` / ``"v" < 5`` / ``"v" < 6`` / ``"v" < 8`` / ``"v" < 9``)
-line, so any addition requires a version bump (versioning policy in
-README.md).
+v2/v7/v8/v10-only event types (resp. v3/v4/v5/v6/v8/v9-only fields) are
+invalid on a ``"v" < 2`` / ``"v" < 7`` / ``"v" < 8`` / ``"v" < 10``
+(resp. ``"v" < 3`` / ``"v" < 4`` / ``"v" < 5`` / ``"v" < 6`` /
+``"v" < 8`` / ``"v" < 9``) line, so any addition requires a version
+bump (versioning policy in README.md).
 """
 
 from __future__ import annotations
@@ -153,8 +165,8 @@ import subprocess
 import threading
 import time
 
-SCHEMA_VERSION = 9
-_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)  # versions validate_event accepts
+SCHEMA_VERSION = 10
+_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)  # versions validate_event accepts
 
 # Environment knobs (set by check.py --events/--phase-timers; inherited by
 # liveness re-runs and bench children the same way RAFT_TLA_SIGPRUNE is).
@@ -223,6 +235,7 @@ _REQUIRED = {
     "quarantine": {"job_id": str, "reason": str},
     "span": {"name": str, "span_id": int, "t0": _NUM, "dur": _NUM,
              "thread": str},
+    "metrics_snapshot": {"metrics": dict},
 }
 
 # Event types that only exist from schema version 2 on (the campaign
@@ -237,6 +250,10 @@ _V7_EVENTS = frozenset({"worker_spawn", "worker_lost", "job_retry",
 # Event types that only exist from schema version 8 on (the cross-process
 # tracing layer, obs/trace.py) — invalid on a "v" < 8 line.
 _V8_EVENTS = frozenset({"span"})
+
+# Event types that only exist from schema version 10 on (the live
+# metrics layer, obs/metrics.py) — invalid on a "v" < 10 line.
+_V10_EVENTS = frozenset({"metrics_snapshot"})
 
 # Fields that only exist from schema version 3 on (walker-fleet
 # statistical checking) — invalid on a "v" < 3 line.
@@ -291,6 +308,7 @@ _OPTIONAL = {
     "job_retry": {"worker": str, "backoff_s": _NUM, "reason": str},
     "quarantine": {"deaths": int, "worker": str, "detail": str},
     "span": {"parent_id": int, "args": dict},
+    "metrics_snapshot": {"port": int, "root": str},
 }
 
 
@@ -322,6 +340,8 @@ def validate_event(d: dict) -> list:
         errs.append(f"{ev}: event type requires schema version >= 7")
     if ev in _V8_EVENTS and d["v"] in _VERSIONS and d["v"] < 8:
         errs.append(f"{ev}: event type requires schema version >= 8")
+    if ev in _V10_EVENTS and d["v"] in _VERSIONS and d["v"] < 10:
+        errs.append(f"{ev}: event type requires schema version >= 10")
     req, opt = _REQUIRED[ev], _OPTIONAL[ev]
     for k, spec in req.items():
         if k not in d:

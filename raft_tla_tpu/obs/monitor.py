@@ -229,12 +229,22 @@ def summarize(stream: dict, window_s: float = 600.0,
     events = stream["events"]
     pool = _pool_counts(events)
     if not segments:
-        if pool is None:
-            return None
-        # A pure supervision log (serve pool.events) has no segment
-        # timeline; the pool lifecycle IS the heartbeat.
-        return {"pool": pool, "pool_only": True,
-                "n_invalid": len(stream["invalid"])}
+        if pool is not None:
+            # A pure supervision log (serve pool.events) has no segment
+            # timeline; the pool lifecycle IS the heartbeat.
+            return {"pool": pool, "pool_only": True,
+                    "n_invalid": len(stream["invalid"])}
+        snaps = [e for e in events if e["event"] == "metrics_snapshot"]
+        if snaps:
+            # A metrics log (OUT/metrics.events, schema v10): the last
+            # snapshot carries the endpoint's whole registry — latency
+            # quantiles, queue depth — replayable without the endpoint.
+            last = snaps[-1]
+            return {"metrics": dict(last.get("metrics") or {}),
+                    "metrics_ts": last.get("ts"),
+                    "metrics_only": True,
+                    "n_invalid": len(stream["invalid"])}
+        return None
     cur = segments[-1]
     summary = {
         "level": cur["level"],
@@ -323,12 +333,61 @@ def _fmt_pool(pool: dict) -> str:
     return tag
 
 
+def _parse_series(key: str) -> tuple:
+    """``name{k="v",...}`` -> (name, labels) for metrics_snapshot keys
+    (the flat Prometheus-style names obs/metrics.py snapshots)."""
+    if "{" not in key:
+        return key, {}
+    name, _, body = key.partition("{")
+    labels = {}
+    for part in body.rstrip("}").split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            labels[k] = v.strip('"')
+    return name, labels
+
+
+def _metrics_rows(snap: dict, age_s: float | None) -> list:
+    """The fleet metrics rows, one unit per row: per-tenant p99
+    admission-to-result latency (ms), queue depth (jobs), and endpoint
+    liveness (seconds since the last snapshot)."""
+    p99: dict = {}
+    depth = None
+    for key, val in snap.items():
+        if not isinstance(val, (int, float)) or isinstance(val, bool):
+            continue
+        name, labels = _parse_series(key)
+        if name == "raft_tla_latency_seconds" \
+                and labels.get("quantile") == "0.99":
+            p99[labels.get("tenant", "(all)")] = val
+        elif name == "raft_tla_queue_depth":
+            depth = val
+    rows = [f"p99 latency {tenant}: {p99[tenant] * 1000.0:,.0f} ms"
+            for tenant in sorted(p99)]
+    if depth is not None:
+        rows.append(f"queue depth: {depth:.0f} jobs")
+    if age_s is not None:
+        state = "live" if age_s <= 120.0 else "stale"
+        rows.append(f"metrics endpoint: {state} "
+                    f"(last snapshot {age_s:.0f} s ago)")
+    return rows
+
+
 def heartbeat(summary: dict | None) -> str:
     """Render the one-line heartbeat."""
     if summary is None:
         return "obs: no segments yet"
     if summary.get("pool_only"):
         line = _fmt_pool(summary["pool"])
+        if summary["n_invalid"]:
+            line += f"  [{summary['n_invalid']} invalid lines]"
+        return line
+    if summary.get("metrics_only"):
+        age = None
+        if isinstance(summary.get("metrics_ts"), (int, float)):
+            age = max(0.0, time.time() - summary["metrics_ts"])
+        rows = _metrics_rows(summary.get("metrics") or {}, age)
+        line = " | ".join(rows) if rows else "metrics: empty snapshot"
         if summary["n_invalid"]:
             line += f"  [{summary['n_invalid']} invalid lines]"
         return line
@@ -407,8 +466,16 @@ def fleet_view(root: str, window_s: float = 600.0,
               "pool": {"spawns": 0, "losses": 0, "retries": 0,
                        "quarantined": []}}
     pooled = False
+    metrics_summary = None
     for _name, s in rows:
         if s is None:
+            continue
+        if s.get("metrics_only"):
+            # newest snapshot wins: one endpoint per fleet directory
+            if metrics_summary is None or \
+                    (s.get("metrics_ts") or 0) > \
+                    (metrics_summary.get("metrics_ts") or 0):
+                metrics_summary = s
             continue
         if s.get("pool"):
             pooled = True
@@ -428,6 +495,7 @@ def fleet_view(root: str, window_s: float = 600.0,
             totals["ended"] += 1
     if not pooled:
         totals["pool"] = None
+    totals["metrics"] = metrics_summary
     return rows, totals
 
 
@@ -442,6 +510,13 @@ def _fleet_lines(rows: list, totals: dict) -> str:
     if totals["pool"]:
         agg.append(_fmt_pool(totals["pool"]))
     lines.append(" | ".join(agg))
+    ms = totals.get("metrics")
+    if ms:
+        age = None
+        if isinstance(ms.get("metrics_ts"), (int, float)):
+            age = max(0.0, time.time() - ms["metrics_ts"])
+        for row in _metrics_rows(ms.get("metrics") or {}, age):
+            lines.append(f"  {row}")
     return "\n".join(lines)
 
 

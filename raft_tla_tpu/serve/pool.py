@@ -50,6 +50,7 @@ import sys
 import time
 
 from raft_tla_tpu.obs import append_event
+from raft_tla_tpu.obs.metrics import ENV_METRICS
 from raft_tla_tpu.campaign.supervisor import DecorrelatedBackoff
 from raft_tla_tpu.serve import supervise
 from raft_tla_tpu.serve.service import (_append_records, _events_path,
@@ -312,10 +313,17 @@ def run_pool(jobs, out_dir: str, *, workers: int = 2, chunk: int = 1024,
             argv += ["--cpu"]
         out_path = os.path.join(pool_dir, f"{wid}.out")
         out_f = open(out_path, "wb")
+        # Workers inherit the environment EXCEPT the metrics gate: the
+        # pool's supervising process owns the one endpoint over out_dir
+        # (it already sees every tenant log the workers write), and a
+        # child re-binding the same port would die at startup.
+        child_env = dict(os.environ)
+        child_env.pop(ENV_METRICS, None)
         try:
             proc = subprocess.Popen(argv, stdout=out_f,
                                     stderr=subprocess.STDOUT,
-                                    stdin=subprocess.DEVNULL)
+                                    stdin=subprocess.DEVNULL,
+                                    env=child_env)
         finally:
             out_f.close()
         health = WorkerHealth(

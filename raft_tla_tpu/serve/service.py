@@ -567,6 +567,13 @@ def build_argparser() -> argparse.ArgumentParser:
                         "plus scheduler dispatch/harvest/compile/ticket "
                         "spans into OUT/sched-<pid>.events; merge with "
                         "raft-tla-trace")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="P",
+                   help="expose a live OpenMetrics endpoint on "
+                        "127.0.0.1:P (0 = ephemeral port; also via "
+                        "RAFT_TLA_METRICS): per-tenant p50/p95/p99 "
+                        "admission-to-result latency, queue depth, "
+                        "per-bin inflight and pool-worker gauges, "
+                        "snapshotted into OUT/metrics.events")
     p.add_argument("--quiet", action="store_true",
                    help="suppress per-job progress lines")
     p.add_argument("--drain-on-sigint", action="store_true",
@@ -599,6 +606,28 @@ def main(argv=None) -> int:
     cache_dir = enable_compile_cache(args.compile_cache)
     if cache_dir and not args.quiet:
         print(f"compile cache: {cache_dir}")
+    from raft_tla_tpu.obs.metrics import metrics_port
+    mport = metrics_port(args.metrics_port)
+    mserver = None
+    if mport is not None:
+        # The endpoint lives in THIS supervising process and only READS
+        # the out dir's event logs (each scrape tails the new bytes) —
+        # the engines' off-path cost is untouched (tel.active
+        # discipline; A/B'd by runs/obs_overhead_ab.py events+metrics).
+        from raft_tla_tpu.obs.openmetrics import MetricsServer
+        os.makedirs(args.out, exist_ok=True)
+        mserver = MetricsServer(
+            args.out, port=mport,
+            snapshot_path=os.path.join(args.out, "metrics.events"))
+        print(f"metrics endpoint: {mserver.url}", flush=True)
+    try:
+        return _run_front(args)
+    finally:
+        if mserver is not None:
+            mserver.close()
+
+
+def _run_front(args) -> int:
     if args.watch:
         return run_daemon(args.source, args.out, chunk=args.chunk,
                           max_states=args.max_states, quiet=args.quiet,

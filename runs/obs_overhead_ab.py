@@ -7,8 +7,10 @@ per-segment device fetch, and ``phases.phase()`` returns a shared no-op
 handle.  The priced arms then show what turning the instruments ON
 costs: the events log (async writer + per-segment fetch), v8 trace
 spans (host-side span emission through the same log — NO device syncs,
-the pipelining survives), and the phase timers (a device sync per
-phase — the documented pipelining trade).
+the pipelining survives), the phase timers (a device sync per
+phase — the documented pipelining trade), and the live OpenMetrics
+endpoint (a MetricsServer tailing the log from the same process — a
+pure log READER, so the claim is metrics_over_off ~ events_over_off).
 
 Protocol (the chip-state-fiducial discipline of RESULTS.md "sig-prune
 A/B"): arms interleave round-robin so machine drift hits all arms
@@ -74,9 +76,24 @@ def fiducial() -> dict:
 
 def run_arm(arm: str, tmp: str) -> float:
     events = None
+    server = None
     os.environ.pop(ENV_PHASE_TIMERS, None)
     os.environ.pop(ENV_TRACE, None)
-    if arm != "off":
+    if arm == "events+metrics":
+        # The live-endpoint arm: a MetricsServer mounted over a FRESH
+        # per-rep directory (so tail state never accumulates across
+        # reps) with the snapshot loop running at its cadence — the
+        # realistic always-on cost.  The server only ever READS the
+        # log; the engine is configured identically to the events arm.
+        from raft_tla_tpu.obs.openmetrics import MetricsServer
+        sub = os.path.join(tmp, f"metrics-{time.monotonic_ns()}")
+        os.makedirs(sub)
+        events = os.path.join(sub, "tenant.events")
+        server = MetricsServer(
+            sub, port=0,
+            snapshot_path=os.path.join(sub, "metrics.events"),
+            interval_s=5.0)
+    elif arm != "off":
         events = os.path.join(tmp, f"{arm}-{time.monotonic_ns()}.events")
     if arm == "events+timers":
         os.environ[ENV_PHASE_TIMERS] = "1"
@@ -85,6 +102,8 @@ def run_arm(arm: str, tmp: str) -> float:
     t0 = time.monotonic()
     r = DeviceEngine(CFG, CAPS).check(events=events)
     wall = time.monotonic() - t0
+    if server is not None:
+        server.close()                   # final poll+snapshot off the clock
     os.environ.pop(ENV_PHASE_TIMERS, None)
     os.environ.pop(ENV_TRACE, None)
     assert r.n_states == N_EXPECT and r.complete, (arm, r.n_states)
@@ -93,7 +112,8 @@ def run_arm(arm: str, tmp: str) -> float:
 
 def main():
     reps = int(sys.argv[1]) if len(sys.argv) > 1 else 3
-    arms = ("off", "events", "events+trace", "events+timers")
+    arms = ("off", "events", "events+trace", "events+timers",
+            "events+metrics")
     walls: dict = {a: [] for a in arms}
     with tempfile.TemporaryDirectory() as tmp, open(OUT, "a") as out:
         for rep in range(reps):
@@ -115,6 +135,8 @@ def main():
             "events_over_off": round(med["events"] / med["off"], 4),
             "trace_over_off": round(med["events+trace"] / med["off"], 4),
             "timers_over_off": round(med["events+timers"] / med["off"], 4),
+            "metrics_over_off": round(med["events+metrics"] / med["off"],
+                                      4),
         }
         print(json.dumps(summary))
         out.write(json.dumps(summary) + "\n")

@@ -1,0 +1,286 @@
+"""Live metrics layer (ISSUE 20 tentpole): the mergeable log-bucketed
+histogram's error bound, the registry/exposition round trip, the
+streaming aggregator's event reductions, and the OpenMetrics endpoint
+end to end (scrape + replayable schema-v10 snapshot).
+
+The histogram tests are the load-bearing ones: every latency quantile
+the endpoint reports rides on ``LogHistogram``'s guarantee that any
+quantile answered from geometric bucket midpoints is within
+``sqrt(gamma) - 1`` (~9.05%) of the exact sample quantile — checked
+here against NumPy's ``inverted_cdf`` (the same rank convention) on
+adversarial distributions, plus exact associativity of ``merge`` (the
+fleet roll-up property).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tla_tpu.obs.events import append_event, validate_event
+from raft_tla_tpu.obs.metrics import (_GAMMA, ENV_METRICS, LogHistogram,
+                                      MetricsAggregator, MetricsRegistry,
+                                      metrics_port)
+from raft_tla_tpu.obs.openmetrics import MetricsServer, render
+
+# The documented bound: bucket base 2**(1/4), midpoint answers are
+# within sqrt(gamma) - 1 of the exact sample quantile.
+_BOUND = _GAMMA ** 0.5 - 1.0
+_QS = (0.5, 0.95, 0.99)
+
+
+def _exact(xs, q):
+    return float(np.quantile(np.asarray(xs), q, method="inverted_cdf"))
+
+
+# --------------------------------------------------------------------------
+# histogram
+
+
+@pytest.mark.parametrize("dist", ["lognormal", "exponential", "uniform",
+                                  "tiny", "bimodal"])
+def test_histogram_quantile_error_bound(dist):
+    """Relative error vs the exact inverted-CDF sample quantile stays
+    under sqrt(gamma)-1 on heavy-tailed, light, sub-1.0 (negative
+    bucket indices) and bimodal data."""
+    rng = np.random.default_rng(7)
+    xs = {
+        "lognormal": rng.lognormal(0.0, 2.0, 5000),
+        "exponential": rng.exponential(3.0, 5000),
+        "uniform": rng.uniform(10.0, 1000.0, 5000),
+        "tiny": rng.uniform(1e-6, 1e-3, 5000),      # all buckets < 0
+        "bimodal": np.concatenate([rng.normal(1.0, 0.01, 2500),
+                                   rng.normal(1e4, 1.0, 2500)]).clip(1e-9),
+    }[dist]
+    h = LogHistogram()
+    for v in xs:
+        h.add(float(v))
+    for q in _QS:
+        exact = _exact(xs, q)
+        got = h.quantile(q)
+        assert abs(got - exact) / exact <= _BOUND, (dist, q, got, exact)
+
+
+def test_histogram_empty_one_sample_and_clamp():
+    h = LogHistogram()
+    assert h.quantile(0.5) is None                # empty: no answer
+    h.add(2.5)
+    for q in _QS:
+        assert h.quantile(q) == 2.5               # one sample is exact
+    z = LogHistogram()
+    z.add(0.0)                                    # same-ts latency rounds to 0
+    assert 0.0 <= z.quantile(0.99) <= 1e-300      # clamp bucket, ~0
+    assert z.n == 1 and z.total == 0.0
+
+
+def test_histogram_merge_is_exactly_associative():
+    rng = np.random.default_rng(11)
+    parts = [rng.lognormal(0.0, 1.5, 700) for _ in range(3)]
+    a, b, c = (LogHistogram() for _ in range(3))
+    for h, xs in zip((a, b, c), parts):
+        for v in xs:
+            h.add(float(v))
+    left = a.merge(b).merge(c)
+    right = a.merge(b.merge(c))
+    assert left.counts == right.counts            # dict-sum: exact
+    assert left.n == right.n == sum(len(p) for p in parts)
+    assert left.total == right.total
+    assert left.vmin == right.vmin and left.vmax == right.vmax
+    for q in _QS:
+        assert left.quantile(q) == right.quantile(q)
+    # and the merge equals one histogram over the concatenation
+    whole = LogHistogram()
+    for xs in parts:
+        for v in xs:
+            whole.add(float(v))
+    assert whole.counts == left.counts
+
+
+def test_histogram_dict_round_trip():
+    h = LogHistogram()
+    for v in (0.25, 1.0, 7.5, 1e4):
+        h.add(v)
+    rt = LogHistogram.from_dict(json.loads(json.dumps(h.to_dict())))
+    assert rt.counts == h.counts and rt.n == h.n
+    for q in _QS:
+        assert rt.quantile(q) == h.quantile(q)
+
+
+# --------------------------------------------------------------------------
+# gate resolver / registry / exposition
+
+
+def test_metrics_port_gate_resolution(monkeypatch):
+    monkeypatch.delenv(ENV_METRICS, raising=False)
+    assert metrics_port(None) is None             # off by default
+    assert metrics_port(9108) == 9108             # explicit wins
+    assert metrics_port(0) == 0                   # 0 = ephemeral, still on
+    monkeypatch.setenv(ENV_METRICS, "9200")
+    assert metrics_port(None) == 9200
+    assert metrics_port(9108) == 9108             # explicit beats env
+    monkeypatch.setenv(ENV_METRICS, "not-a-port")
+    assert metrics_port(None) is None             # unparseable = off
+
+
+def test_registry_snapshot_and_render():
+    reg = MetricsRegistry()
+    reg.inc("raft_tla_events", 1, event="segment")
+    reg.inc("raft_tla_events", 2, event="segment")
+    reg.set_gauge("raft_tla_queue_depth", 3)
+    reg.observe("raft_tla_latency_seconds", 2.5, tenant="a")
+    snap = reg.snapshot()
+    assert snap['raft_tla_events_total{event="segment"}'] == 3
+    assert snap["raft_tla_queue_depth"] == 3
+    # labels sorted, quantile appended last; one sample is exact
+    assert snap['raft_tla_latency_seconds{tenant="a",quantile="0.99"}'] \
+        == 2.5
+    assert snap['raft_tla_latency_seconds_count{tenant="a"}'] == 1
+    # every snapshot key is a legal metrics_snapshot payload
+    ev = {"v": 10, "event": "metrics_snapshot", "ts": 0.0, "metrics": snap}
+    assert validate_event(ev) == []
+    text = render(reg)
+    assert "# TYPE raft_tla_events_total counter" in text
+    assert "# TYPE raft_tla_queue_depth gauge" in text
+    assert "# TYPE raft_tla_latency_seconds summary" in text
+    assert 'raft_tla_latency_seconds{tenant="a",quantile="0.5"} 2.5' in text
+    assert 'raft_tla_latency_seconds_sum{tenant="a"} 2.5' in text
+
+
+# --------------------------------------------------------------------------
+# aggregator (streaming reducer over event logs)
+
+
+def _tenant_log(path, t0, t_end=None, inflight=None):
+    append_event(path, "run_start", ts=t0, engine="device",
+                 universe={"servers": 2, "values": 1}, spec="election",
+                 invariants=["NoTwoLeaders"], resumed=False)
+    seg = dict(ts=t0 + 1.0, wall_s=1.0, n_states=100, level=2,
+               n_transitions=200, dedup_hit_rate=0.5, since_resume=True,
+               states_per_sec=100.0, inc_states_per_sec=100.0,
+               flush_backlog=4)
+    if inflight is not None:
+        seg.update(bin="b0", inflight=inflight)
+    append_event(path, "segment", **seg)
+    if t_end is not None:
+        append_event(path, "run_end", ts=t_end, n_states=100,
+                     n_transitions=200, complete=True, outcome="ok")
+
+
+def test_aggregator_latency_queue_and_gauges(tmp_path):
+    _tenant_log(str(tmp_path / "job-a.events"), 100.0, t_end=102.5,
+                inflight=2)
+    _tenant_log(str(tmp_path / "job-b.events"), 200.0)   # still running
+    agg = MetricsAggregator(str(tmp_path))
+    agg.poll()
+    snap = agg.registry.snapshot()
+    # admission (run_start ts) -> terminal (run_end ts) = 2.5 s, exact
+    assert snap['raft_tla_latency_seconds{tenant="job-a",'
+                'quantile="0.99"}'] == 2.5
+    assert snap['raft_tla_latency_seconds{quantile="0.99"}'] == 2.5
+    assert snap["raft_tla_queue_depth"] == 1             # job-b un-ended
+    assert snap['raft_tla_inflight{bin="b0",tenant="job-a"}'] == 2
+    assert snap['raft_tla_flush_backlog{tenant="job-b"}'] == 4
+    assert snap['raft_tla_inc_states_per_sec{tenant="job-a"}'] == 100.0
+    assert snap['raft_tla_runs_ended_total{outcome="ok",'
+                'tenant="job-a"}'] == 1
+    # incremental: a second poll with no new bytes changes nothing
+    before = dict(snap)
+    agg.poll()
+    assert agg.registry.snapshot() == before
+    # ...and a run_end appended later closes job-b's latency + queue
+    append_event(str(tmp_path / "job-b.events"), "run_end", ts=204.0,
+                 n_states=100, n_transitions=200, complete=True,
+                 outcome="ok")
+    agg.poll()
+    snap = agg.registry.snapshot()
+    assert snap["raft_tla_queue_depth"] == 0
+    assert snap['raft_tla_latency_seconds{tenant="job-b",'
+                'quantile="0.5"}'] == 4.0
+
+
+def test_aggregator_pool_lifecycle_and_snapshot_immunity(tmp_path):
+    p = str(tmp_path / "pool.events")
+    append_event(p, "worker_spawn", ts=1.0, worker="w0", pid=11)
+    append_event(p, "worker_spawn", ts=2.0, worker="w1", pid=12)
+    append_event(p, "worker_lost", ts=3.0, worker="w0", kind="killed")
+    append_event(p, "job_retry", ts=4.0, job_id="a", attempt=1)
+    append_event(p, "quarantine", ts=5.0, job_id="a", reason="poison-job")
+    # a metrics_snapshot in the swept root must NOT feed back
+    append_event(str(tmp_path / "metrics.events"), "metrics_snapshot",
+                 ts=6.0, metrics={"raft_tla_queue_depth": 99.0})
+    agg = MetricsAggregator(str(tmp_path))
+    agg.poll()
+    snap = agg.registry.snapshot()
+    assert snap["raft_tla_workers_spawned_total"] == 2
+    assert snap['raft_tla_workers_lost_total{kind="killed"}'] == 1
+    assert snap["raft_tla_workers_live"] == 1
+    assert snap["raft_tla_job_retries_total"] == 1
+    assert snap["raft_tla_quarantines_total"] == 1
+    assert snap["raft_tla_queue_depth"] == 0             # not 99: no feedback
+
+
+# --------------------------------------------------------------------------
+# endpoint end to end
+
+
+def test_metrics_server_scrape_and_snapshot(tmp_path):
+    _tenant_log(str(tmp_path / "smoke-a.events"), 10.0, t_end=12.5,
+                inflight=2)
+    snap_path = str(tmp_path / "metrics.events")
+    server = MetricsServer(str(tmp_path), port=0, snapshot_path=snap_path,
+                           interval_s=3600.0)      # snapshots on close only
+    try:
+        assert server.url == f"http://127.0.0.1:{server.port}/metrics"
+        with urllib.request.urlopen(server.url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode("utf-8")
+        assert 'raft_tla_latency_seconds{tenant="smoke-a",' \
+               'quantile="0.99"} 2.5' in body
+        assert "raft_tla_queue_depth 0" in body
+        assert 'raft_tla_inflight{bin="b0",tenant="smoke-a"} 2' in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/nope", timeout=10)
+    finally:
+        server.close()
+    server.close()                                 # idempotent
+    with open(snap_path) as fh:
+        evs = [json.loads(line) for line in fh]
+    assert evs, "close() must leave a final snapshot"
+    for e in evs:
+        assert validate_event(e) == [], e
+        assert e["event"] == "metrics_snapshot"
+        assert e["port"] == server.port
+    assert evs[-1]["metrics"]['raft_tla_latency_seconds'
+                              '{tenant="smoke-a",quantile="0.99"}'] == 2.5
+
+
+# --------------------------------------------------------------------------
+# monitor rendering of snapshots (satellite: fleet metrics rows)
+
+
+def test_monitor_renders_metrics_snapshot_rows(tmp_path):
+    from raft_tla_tpu.obs import monitor
+
+    p = str(tmp_path / "metrics.events")
+    append_event(p, "metrics_snapshot", ts=1.0, metrics={
+        'raft_tla_latency_seconds{tenant="job-a",quantile="0.99"}': 1.5,
+        'raft_tla_latency_seconds{tenant="job-b",quantile="0.99"}': 0.25,
+        'raft_tla_latency_seconds{quantile="0.99"}': 1.5,
+        "raft_tla_queue_depth": 2.0})
+    s = monitor.summarize(monitor.load_stream(p))
+    assert s["metrics_only"] and s["metrics_ts"] == 1.0
+    line = monitor.heartbeat(s)
+    assert "p99 latency job-a: 1,500 ms" in line
+    assert "p99 latency job-b: 250 ms" in line
+    assert "queue depth: 2 jobs" in line
+    assert "metrics endpoint: stale" in line       # ts=1.0 is ancient
+    # fleet view: the snapshot rows ride under the aggregate line
+    _tenant_log(str(tmp_path / "job-a.events"), 5.0, t_end=6.5)
+    rows, totals = monitor.fleet_view(str(tmp_path))
+    assert totals["metrics"] is not None
+    assert totals["n_states"] == 100               # snapshot not double-counted
+    text = monitor._fleet_lines(rows, totals)
+    assert "p99 latency job-a" in text and "queue depth: 2 jobs" in text

@@ -146,7 +146,8 @@ def test_validate_rejects_unknowns_and_type_drift():
     assert validate_event({**ok, "v": 7}) == []             # v7 superset
     assert validate_event({**ok, "v": 8}) == []             # v8 superset
     assert validate_event({**ok, "v": 9}) == []             # v9 superset
-    assert validate_event({**ok, "v": 10})                  # future version
+    assert validate_event({**ok, "v": 10}) == []            # v10 superset
+    assert validate_event({**ok, "v": 11})                  # future version
     assert validate_event({"v": 1, "event": "level_end", "ts": 0.0,
                            "level": 3})                     # missing field
 
@@ -296,6 +297,26 @@ def test_validate_v9_devdedup_segment_fields():
                         for e in errs)
     assert validate_event({**seg, "export_rows": 0.5})     # type drift
     assert validate_event({**seg, "dev_dedup_hits": True})  # bool ≠ int
+
+
+def test_validate_v10_metrics_snapshot():
+    """The metrics layer's periodic exposition dump (one flat dict of
+    series, written by obs/openmetrics.py's snapshot loop) exists only
+    from schema v10 — event-type gated exactly like the v7/v8 types, so
+    a v9 consumer never sees it."""
+    snap = {"v": 10, "event": "metrics_snapshot", "ts": 0.0,
+            "metrics": {"raft_tla_queue_depth": 2.0,
+                        'raft_tla_latency_seconds{tenant="a",'
+                        'quantile="0.99"}': 1.5}}
+    assert validate_event(snap) == []
+    assert validate_event({**snap, "port": 9108, "root": "/tmp/x"}) == []
+    errs = validate_event({**snap, "v": 9})  # v10-only type on a v9 line
+    assert errs and all("requires schema version >= 10" in e for e in errs)
+    assert validate_event({**snap, "metrics": [1, 2]})    # type drift
+    assert validate_event({**snap, "port": "9108"})       # type drift
+    assert validate_event({**snap, "surprise": 1})        # unknown field
+    assert validate_event({"v": 10, "event": "metrics_snapshot",
+                           "ts": 0.0})                    # missing metrics
 
 
 def test_monitor_pool_attribution_rows(tmp_path):

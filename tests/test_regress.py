@@ -1,0 +1,235 @@
+"""Cross-run regression sentinel (ISSUE 20): the shared drift policy
+(obs/history.py — the campaign supervisor's health-watch comparison,
+factored out), the history store's median baselines, ingest of the
+recorded BENCH artifacts, and the ``raft-tla-regress`` CLI verdicts —
+including the mechanical reproduction of the RESULTS.md devdedup
+0.44x warm-rate refutation from ``runs/devdedup_ab.out``.
+"""
+
+import json
+import os
+
+import pytest
+
+from raft_tla_tpu.obs.events import append_event
+from raft_tla_tpu.obs.history import (_DRIFT_EXEMPT, HistoryStore,
+                                      append_bench, bench_record,
+                                      drift_report, fiducial_drift,
+                                      history_path, ingest_file,
+                                      run_record)
+from raft_tla_tpu.obs.regress import (EXIT_DRIFT, EXIT_NO_BASELINE,
+                                      EXIT_OK, EXIT_USAGE, main)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_FILES = sorted(
+    os.path.join(REPO, f) for f in os.listdir(REPO)
+    if f.startswith("BENCH_r") and f.endswith(".json"))
+
+
+# --------------------------------------------------------------------------
+# the shared drift policy
+
+
+def test_fiducial_drift_supervisor_semantics():
+    """Exactly the HealthMonitor comparison: first offending key in
+    sorted order, one-sided growth, exempt set honored."""
+    base = {"synthetic_step_ms": 10.0, "copy_64mb_ms": 20.0,
+            "trace_emit_overhead_us": 0.2}
+    assert fiducial_drift(base, dict(base), 1.5) is None
+    # shrinking is not drift (one-sided: degradation only)
+    assert fiducial_drift(base, {"synthetic_step_ms": 1.0}, 1.5) is None
+    key, ratio = fiducial_drift(
+        base, {"synthetic_step_ms": 40.0, "copy_64mb_ms": 100.0}, 1.5)
+    assert key == "copy_64mb_ms" and ratio == 5.0    # sorted order: c < s
+    # the exempt timing pin never triggers, however wild
+    assert "trace_emit_overhead_us" in _DRIFT_EXEMPT
+    assert fiducial_drift(base, {"trace_emit_overhead_us": 99.0},
+                          1.5) is None
+    # degenerate inputs: no policy, no baseline, no current
+    assert fiducial_drift(base, {"synthetic_step_ms": 99.0}, 0) is None
+    assert fiducial_drift({}, {"x": 9.0}, 1.5) is None
+    assert fiducial_drift({"x": 1.0}, {}, 1.5) is None
+    # non-numeric / non-positive baselines never divide
+    assert fiducial_drift({"x": "fast", "y": 0.0},
+                          {"x": "slow", "y": 9.0}, 1.5) is None
+
+
+def test_drift_report_rate_inversion():
+    """Rate-type keys (states/s, warm rates) compare inverted so >1 is
+    a regression for walls and rates alike under one tolerance."""
+    base = {"wall_s": 100.0, "states_per_sec": 1000.0,
+            "dedup_hit_rate": 0.8, "n_states": 3014}
+    cur = {"wall_s": 120.0, "states_per_sec": 400.0,
+           "dedup_hit_rate": 0.8, "n_states": 3014}
+    rep = drift_report(base, cur, 1.5)
+    assert not rep["ok"]
+    assert rep["keys"]["wall_s"]["ratio"] == 1.2          # current/baseline
+    assert not rep["keys"]["wall_s"]["drift"]
+    assert rep["keys"]["states_per_sec"]["ratio"] == 2.5  # baseline/current
+    assert rep["keys"]["states_per_sec"]["rate"]
+    assert rep["keys"]["states_per_sec"]["drift"]
+    assert rep["worst"] == ("states_per_sec", 2.5)
+    # a faster run is ratio < 1 on both conventions: clean
+    fast = {"wall_s": 50.0, "states_per_sec": 2000.0,
+            "dedup_hit_rate": 0.9, "n_states": 3014}
+    assert drift_report(base, fast, 1.5)["ok"]
+
+
+# --------------------------------------------------------------------------
+# records / store / ingest
+
+
+def test_bench_record_keyed_by_metric_identity():
+    parsed = {"metric": "orbits_per_sec", "unit": "1/s", "value": 100.0}
+    a = bench_record(parsed, ts=1.0)
+    b = bench_record({**parsed, "value": 120.0}, ts=2.0)
+    c = bench_record({**parsed, "metric": "renamed"}, ts=3.0)
+    assert a["key"] == b["key"] != c["key"]     # renamed metric: new key
+    assert a["key"].startswith("bench:")
+    assert bench_record({"metric": "m", "unit": "u"}) is None  # no numbers
+
+
+def test_run_record_from_event_log(tmp_path):
+    p = str(tmp_path / "run.events")
+    append_event(p, "run_start", ts=10.0, engine="device",
+                 universe={"servers": 3, "values": 2}, spec="election",
+                 invariants=["NoTwoLeaders"], resumed=False,
+                 fiducials={"synthetic_step_ms": 12.0})
+    append_event(p, "run_end", ts=110.0, n_states=1000,
+                 n_transitions=2000, complete=True, outcome="ok",
+                 wall_s=100.0)
+    recs = ingest_file(p)
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["kind"] == "run" and rec["key"].startswith("run:")
+    assert rec["parsed"]["synthetic_step_ms"] == 12.0
+    assert rec["parsed"]["n_states"] == 1000
+    assert rec["parsed"]["states_per_sec"] == 10.0
+    # same config -> same key; different bounds -> different key
+    q = str(tmp_path / "other.events")
+    append_event(q, "run_start", ts=20.0, engine="device",
+                 universe={"servers": 5, "values": 2}, spec="election",
+                 invariants=["NoTwoLeaders"], resumed=False)
+    append_event(q, "run_end", ts=21.0, n_states=10, n_transitions=20,
+                 complete=True, outcome="ok")
+    assert ingest_file(q)[0]["key"] != rec["key"]
+
+
+def test_store_baseline_is_per_field_median(tmp_path):
+    store = HistoryStore(str(tmp_path / "h.jsonl"))
+    for wall in (100.0, 300.0, 120.0):
+        store.append(bench_record({"metric": "m", "unit": "s",
+                                   "wall_s": wall}, ts=wall))
+    key = store.load()[0]["key"]
+    assert store.baseline(key) == {"wall_s": 120.0}   # median, not mean
+    assert store.baseline("bench:nope") is None
+
+
+def test_ingest_recorded_bench_artifacts():
+    """The committed BENCH_r0*.json drivers are ingestible as seed
+    history; a failed round (``"parsed": null``) yields no record."""
+    assert len(BENCH_FILES) >= 5
+    by_file = {os.path.basename(f): ingest_file(f) for f in BENCH_FILES}
+    r04 = by_file["BENCH_r04.json"]
+    assert r04 == []                        # parsed: null — no record
+    total = [r for recs in by_file.values() for r in recs]
+    assert len(total) == len(BENCH_FILES) - 1
+    # rounds pinning the same metric share a key (comparable runs)
+    keys = {}
+    for rec in total:
+        keys.setdefault(rec["key"], []).append(rec)
+    assert any(len(v) >= 3 for v in keys.values())
+
+
+def test_append_bench_gate(tmp_path, monkeypatch):
+    monkeypatch.delenv("RAFT_TLA_HISTORY", raising=False)
+    assert history_path(None) is None
+    parsed = {"metric": "m", "unit": "s", "wall_s": 1.0}
+    assert append_bench(parsed) is None               # gate off: no-op
+    hist = str(tmp_path / "h.jsonl")
+    monkeypatch.setenv("RAFT_TLA_HISTORY", hist)
+    assert history_path(None) == hist
+    assert append_bench(parsed, meta={"source": "test"}) == hist
+    recs = HistoryStore(hist).load()
+    assert len(recs) == 1 and recs[0]["meta"]["source"] == "test"
+
+
+# --------------------------------------------------------------------------
+# the CLI (in-process via main(argv) — the CI exit-code contract)
+
+
+@pytest.fixture
+def seeded_history(tmp_path):
+    hist = str(tmp_path / "h.jsonl")
+    assert main(["ingest", *BENCH_FILES, "--history", hist]) == EXIT_OK
+    return hist
+
+
+def test_regress_check_clean_rerun(seeded_history, capsys):
+    """Same-config re-run against its own seed history: within
+    tolerance (the ISSUE 20 acceptance's clean pass)."""
+    r05 = os.path.join(REPO, "BENCH_r05.json")
+    assert main(["check", r05, "--history", seeded_history]) == EXIT_OK
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["verdict"] == "ok" and verdict["drifted"] == []
+    assert verdict["worst"][1] < 1.5
+
+
+def test_regress_check_planted_drift(seeded_history, tmp_path, capsys):
+    """A 10x wall regression against the median baseline must verdict
+    drift with the CI exit code."""
+    with open(os.path.join(REPO, "BENCH_r05.json")) as fh:
+        doc = json.load(fh)
+    for k, v in list(doc["parsed"].items()):
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and ("wall" in k or "_ms" in k):
+            doc["parsed"][k] = v * 10.0
+    bad = str(tmp_path / "slow.json")
+    with open(bad, "w") as fh:
+        json.dump(doc, fh)
+    assert main(["check", bad, "--history", seeded_history]) == EXIT_DRIFT
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["verdict"] == "drift" and verdict["drifted"]
+    assert verdict["worst"][1] > 5.0
+
+
+def test_regress_check_no_baseline(tmp_path, capsys):
+    hist = str(tmp_path / "empty.jsonl")
+    open(hist, "w").close()
+    r05 = os.path.join(REPO, "BENCH_r05.json")
+    assert main(["check", r05, "--history", hist]) == EXIT_NO_BASELINE
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["verdict"] == "no-baseline"
+
+
+def test_regress_usage_without_history(monkeypatch, capsys):
+    monkeypatch.delenv("RAFT_TLA_HISTORY", raising=False)
+    r05 = os.path.join(REPO, "BENCH_r05.json")
+    assert main(["check", r05]) == EXIT_USAGE
+    assert main(["ingest", r05]) == EXIT_USAGE
+    capsys.readouterr()
+
+
+def test_regress_ab_reproduces_devdedup_refutation(capsys):
+    """The recorded devdedup A/B (RESULTS.md: warm rate 0.44x on the
+    full universe — gate REFUTED) must verdict drift mechanically."""
+    out = os.path.join(REPO, "runs", "devdedup_ab.out")
+    assert main(["ab", out]) == EXIT_DRIFT
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["verdict"] == "drift"
+    drifted = set(verdict["drifted"])
+    assert any("on_vs_off_warm_rate" in k for k in drifted)
+    rates = {k: v for k, v in verdict["keys"].items()
+             if "full.on_vs_off_warm_rate" in k}
+    assert rates and all(abs(v["ratio"] - 0.444) < 0.01
+                         for v in rates.values())
+
+
+def test_regress_ab_clean_on_obs_overhead(capsys):
+    """The recorded obs-overhead A/B stays within the gate (the
+    events arm costs ~2%)."""
+    out = os.path.join(REPO, "runs", "bench_obs_ab.out")
+    assert main(["ab", out]) == EXIT_OK
+    verdict = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert verdict["verdict"] == "ok"
+    assert verdict["keys"]["events_over_off"]["ratio"] < 1.1

@@ -323,3 +323,105 @@ grep -q "^Fleet: 2 devices x 32 walkers" "$SERVE_TMP/fleet.out" \
     || { echo "fleet smoke FAILED: no fleet summary"; exit 1; }
 grep -q "behaviors generated" "$SERVE_TMP/fleet.out" \
     || { echo "fleet smoke FAILED: no behaviors line"; exit 1; }
+
+begin metrics "metrics smoke (OpenMetrics endpoint + v10 snapshot, gate on/off identity, CPU)"
+# Gate forced ON (--metrics-port 0 = ephemeral port; RAFT_TLA_METRICS
+# is the equivalent process-wide switch): the toy manifest runs
+# one-pass with the endpoint up, and every stable result field must be
+# identical to the gate-off serve block's records — the endpoint is a
+# pure log reader.  Then the watch daemon with a 2-worker pool: scrape
+# the live endpoint (per-tenant p99 latency summary, queue depth, pool
+# worker counters), SIGINT drain, and the replayable
+# OUT/metrics.events snapshot log must validate as schema v10.
+python -m raft_tla_tpu.serve "$SERVE_TMP/manifest.jsonl" \
+    --out "$SERVE_TMP/mout" --chunk 256 --metrics-port 0 --cpu --quiet \
+    | tee "$SERVE_TMP/metrics_serve.out"
+grep -q "^metrics endpoint: http://127.0.0.1:" \
+    "$SERVE_TMP/metrics_serve.out" \
+    || { echo "metrics smoke FAILED: no endpoint line"; exit 1; }
+python - "$SERVE_TMP/out" "$SERVE_TMP/mout" <<'PY'
+import json, sys
+VOLATILE = ("admission_s", "wall_s", "states_per_sec", "events")
+def canon(out):
+    recs = [json.loads(l) for l in open(f"{out}/results.jsonl")]
+    return sorted(
+        json.dumps({k: v for k, v in r.items() if k not in VOLATILE},
+                   sort_keys=True) for r in recs)
+off, on = canon(sys.argv[1]), canon(sys.argv[2])
+assert off == on, f"gate on/off result records differ:\n{off}\n{on}"
+print("metrics one-pass ok: gate on/off result records identical")
+PY
+mkdir -p "$SERVE_TMP/mqueue"
+python -m raft_tla_tpu.serve "$SERVE_TMP/mqueue" --watch --workers 2 \
+    --out "$SERVE_TMP/mdout" --chunk 64 --poll 0.2 --metrics-port 0 \
+    --cpu --quiet > "$SERVE_TMP/mdaemon.out" &
+MDAEMON_PID=$!
+cat > "$SERVE_TMP/mqueue/001-mjob.json" <<'JOB'
+{"id": "mjob", "cfg": "../toy.cfg", "spec": "election", "max_term": 2, "max_log": 0, "max_msgs": 1}
+JOB
+for _ in $(seq 1 600); do
+    grep -q '"job_id": "mjob"' "$SERVE_TMP/mdout/results.jsonl" \
+        2>/dev/null && break
+    kill -0 "$MDAEMON_PID" 2>/dev/null \
+        || { echo "metrics daemon died early"; exit 1; }
+    sleep 0.3
+done
+MPORT="$(sed -n \
+    's|^metrics endpoint: http://127.0.0.1:\([0-9]*\)/metrics$|\1|p' \
+    "$SERVE_TMP/mdaemon.out")"
+[ -n "$MPORT" ] \
+    || { echo "metrics smoke FAILED: no port in daemon output"; exit 1; }
+python - "$MPORT" <<'PY'
+import sys, urllib.request
+body = urllib.request.urlopen(
+    f"http://127.0.0.1:{sys.argv[1]}/metrics", timeout=10).read().decode()
+assert 'raft_tla_latency_seconds{tenant="mjob",quantile="0.99"}' in body, \
+    body
+assert "raft_tla_queue_depth" in body, body
+assert "raft_tla_workers_spawned_total" in body, body
+print("metrics scrape ok: per-tenant p99 latency + queue depth + "
+      "pool counters served")
+PY
+kill -INT "$MDAEMON_PID"
+wait "$MDAEMON_PID" \
+    || { echo "metrics daemon SIGINT drain exited nonzero"; exit 1; }
+python - "$SERVE_TMP/mdout/metrics.events" <<'PY'
+import json, sys
+from raft_tla_tpu.obs import validate_event
+evs = [json.loads(l) for l in open(sys.argv[1])]
+assert evs and all(e["event"] == "metrics_snapshot" for e in evs), evs
+assert not [err for e in evs for err in validate_event(e)]
+print(f"metrics snapshot ok: {len(evs)} schema-v10 snapshot(s) "
+      "replayable from the log alone")
+PY
+
+begin regress "regress smoke (history ingest -> drift verdicts -> A/B reproduction)"
+# The cross-run sentinel end-to-end (--history PATH; RAFT_TLA_HISTORY
+# is the equivalent): the recorded BENCH drivers seed the store, the
+# same-config round passes clean (exit 0), a planted 10x wall
+# regression exits 4, and the recorded devdedup A/B reproduces its
+# RESULTS.md refutation verdict mechanically.
+python -m raft_tla_tpu.obs.regress ingest BENCH_r0*.json \
+    --history "$SERVE_TMP/history.jsonl"
+python -m raft_tla_tpu.obs.regress check BENCH_r05.json \
+    --history "$SERVE_TMP/history.jsonl" \
+    || { echo "regress smoke FAILED: clean re-run did not exit 0"; exit 1; }
+python - "$SERVE_TMP/slow.json" <<'PY'
+import json, sys
+doc = json.load(open("BENCH_r05.json"))
+for k, v in list(doc["parsed"].items()):
+    if isinstance(v, (int, float)) and not isinstance(v, bool) \
+        and ("wall" in k or k.endswith("_ms")):
+        doc["parsed"][k] = v * 10.0
+json.dump(doc, open(sys.argv[1], "w"))
+PY
+rc=0
+python -m raft_tla_tpu.obs.regress check "$SERVE_TMP/slow.json" \
+    --history "$SERVE_TMP/history.jsonl" || rc=$?
+[ "$rc" -eq 4 ] \
+    || { echo "regress smoke FAILED: planted drift exit $rc != 4"; exit 1; }
+rc=0
+python -m raft_tla_tpu.obs.regress ab runs/devdedup_ab.out || rc=$?
+[ "$rc" -eq 4 ] \
+    || { echo "regress smoke FAILED: devdedup ab exit $rc != 4"; exit 1; }
+echo "regress smoke ok: clean pass, planted drift caught (exit 4), devdedup refutation reproduced"
